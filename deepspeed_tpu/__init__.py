@@ -1,0 +1,90 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Same product surface as DeepSpeed (reference ``deepspeed/__init__.py``):
+``initialize()`` (:53), ``init_inference()`` (:215),
+``add_config_arguments()`` (:192), ``init_distributed`` re-export (:30) —
+built on JAX/XLA/pjit/Pallas instead of torch/CUDA.
+"""
+
+from typing import Optional
+
+from deepspeed_tpu.version import __version__
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               mesh=None,
+               seed: int = 42):
+    """Initialize the engine (reference ``deepspeed/__init__.py:53-148``).
+
+    Returns the tuple ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    ``model`` may be a flax-style module (``.apply`` returning the loss, plus
+    optionally ``.init_params(rng)``) or a pure callable
+    ``fn(params, batch, rng, train) -> loss``.  ``model_parameters`` is the
+    initial parameter pytree (the analogue of passing
+    ``model.parameters()``).  A pipeline-module model dispatches to the
+    PipelineEngine exactly as the reference does (``__init__.py:135``).
+    """
+    log_dist(f"deepspeed_tpu info: version={__version__}", ranks=[0])
+    config = config if config is not None else config_params
+
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    if isinstance(model, PipelineModule):
+        try:
+            from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        except ImportError as e:
+            raise NotImplementedError(
+                "PipelineEngine is not available in this build") from e
+        engine = PipelineEngine(args=args, model=model, optimizer=optimizer,
+                                model_parameters=model_parameters, training_data=training_data,
+                                lr_scheduler=lr_scheduler, mpu=model.mpu() if hasattr(model, "mpu") else mpu,
+                                dist_init_required=dist_init_required, collate_fn=collate_fn,
+                                config=config, mesh=mesh, seed=seed)
+    else:
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+        engine = DeepSpeedEngine(args=args, model=model, optimizer=optimizer,
+                                 model_parameters=model_parameters, training_data=training_data,
+                                 lr_scheduler=lr_scheduler, mpu=mpu,
+                                 dist_init_required=dist_init_required, collate_fn=collate_fn,
+                                 config=config, mesh=mesh, seed=seed)
+
+    return engine, engine.tx, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Build an InferenceEngine (reference ``deepspeed/__init__.py:215``)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    cfg_dict = dict(config or {})
+    cfg_dict.update(kwargs)
+    ds_inference_config = DeepSpeedInferenceConfig(**cfg_dict)
+    return InferenceEngine(model, config=ds_inference_config)
+
+
+def add_config_arguments(parser):
+    """Augment an argparse parser with DeepSpeed flags (reference
+    ``deepspeed/__init__.py:192``)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no impact on engine)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable flag")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated config path")
+    return parser

@@ -1,0 +1,65 @@
+"""CIFAR ResNet — BASELINE workload 1 (CIFAR-10 ResNet via initialize()).
+
+A standard pre-activation ResNet in flax, loss-returning per the framework
+convention.  Small enough to run on the CPU mesh in CI.
+"""
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.GroupNorm(num_groups=8)(x)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME",
+                    use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=8)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=(self.strides, self.strides),
+                               use_bias=False)(residual)
+        return y + residual
+
+
+class ResNetCIFAR(nn.Module):
+    """ResNet-(6n+2) for 32x32 inputs; depth 20 by default."""
+    num_classes: int = 10
+    depth: int = 20
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, images, labels, train: bool = True):
+        n = (self.depth - 2) // 6
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False)(images)
+        for i, (filters, stride) in enumerate([(self.width, 1), (self.width * 2, 2),
+                                               (self.width * 4, 2)]):
+            for b in range(n):
+                x = ResNetBlock(filters, strides=stride if b == 0 else 1)(x, train=train)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(x)
+        loss = jnp.mean(-jnp.sum(
+            jax.nn.log_softmax(logits) * jax.nn.one_hot(labels, self.num_classes), axis=-1))
+        return loss
+
+    def init_params(self, rng, batch_size: int = 2):
+        images = jnp.zeros((batch_size, 32, 32, 3), jnp.float32)
+        labels = jnp.zeros((batch_size,), jnp.int32)
+        return self.init(rng, images, labels)["params"]
+
+    def init_variables(self, rng, batch_size: int = 2):
+        images = jnp.zeros((batch_size, 32, 32, 3), jnp.float32)
+        labels = jnp.zeros((batch_size,), jnp.int32)
+        return self.init(rng, images, labels)

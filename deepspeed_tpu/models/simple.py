@@ -1,0 +1,47 @@
+"""Simple models for tests and examples.
+
+Analogue of the reference's ``tests/unit/simple_model.py`` (SimpleModel &
+friends), kept in the package so examples/bench can share them.  Models
+follow the framework convention: ``__call__(*batch, train=...)`` returns the
+scalar loss; ``init_params(rng)`` builds the parameter pytree.
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SimpleModel(nn.Module):
+    """Linear stack + cross-entropy, mirroring reference SimpleModel
+    (``tests/unit/simple_model.py``: Linear layers + CrossEntropyLoss)."""
+    hidden_dim: int
+    nlayers: int = 1
+    empty_grad: bool = False
+
+    @nn.compact
+    def __call__(self, x, y, train: bool = True):
+        for _ in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim)(x)
+        logits = x
+        loss = jnp.mean(
+            -jnp.sum(jax.nn.log_softmax(logits) * jax.nn.one_hot(y, logits.shape[-1]), axis=-1))
+        return loss
+
+    def init_params(self, rng, batch_size: int = 4):
+        x = jnp.zeros((batch_size, self.hidden_dim), jnp.float32)
+        y = jnp.zeros((batch_size,), jnp.int32)
+        return self.init(rng, x, y)["params"]
+
+
+def random_dataset(total_samples: int, hidden_dim: int, nclasses: Optional[int] = None,
+                   seed: int = 0):
+    """List-style dataset of (x, y) tuples (reference
+    ``simple_model.py:random_dataloader``)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    nclasses = nclasses or hidden_dim
+    xs = rng.standard_normal((total_samples, hidden_dim), dtype=np.float32)
+    ys = rng.integers(0, nclasses, size=(total_samples,))
+    return [(xs[i], ys[i].astype(np.int32)) for i in range(total_samples)]

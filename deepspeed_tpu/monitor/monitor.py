@@ -1,0 +1,106 @@
+"""Experiment monitoring fan-out.
+
+Reference: ``deepspeed/monitor/monitor.py:MonitorMaster:29`` dispatching
+``(name, value, global_samples)`` event tuples to TensorBoard / W&B / CSV
+writers.  Writers are optional; anything unavailable degrades to a no-op
+with a one-time warning.
+"""
+
+import os
+from typing import List, Tuple
+
+from deepspeed_tpu.utils.logging import logger, warning_once
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    def write_events(self, event_list: List[Tuple]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            path = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+            self.summary_writer = SummaryWriter(log_dir=path)
+        except Exception as e:
+            warning_once(f"tensorboard writer unavailable: {e}")
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = False
+        try:
+            import wandb
+            wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+            self.enabled = True
+        except Exception as e:
+            warning_once(f"wandb unavailable: {e}")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        import wandb
+        for name, value, step in event_list:
+            wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.output_path = cfg.output_path or "./csv_monitor"
+        self.job_name = cfg.job_name
+        os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+        self.filenames = {}
+
+    def write_events(self, event_list):
+        import csv
+        for name, value, step in event_list:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled writer, rank-0 only (reference
+    ``monitor/monitor.py:29``)."""
+
+    def __init__(self, ds_config):
+        super().__init__(ds_config)
+        import jax
+        self.rank = jax.process_index()
+        self.writers = []
+        if self.rank == 0:
+            if ds_config.tensorboard_config.enabled:
+                self.writers.append(TensorBoardMonitor(ds_config.tensorboard_config))
+            if ds_config.wandb_config.enabled:
+                self.writers.append(WandbMonitor(ds_config.wandb_config))
+            if ds_config.csv_monitor_config.enabled:
+                self.writers.append(csvMonitor(ds_config.csv_monitor_config))
+
+    def write_events(self, event_list):
+        for w in self.writers:
+            w.write_events(event_list)

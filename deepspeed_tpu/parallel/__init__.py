@@ -1,0 +1,6 @@
+from deepspeed_tpu.parallel.mesh import (BATCH_AXES, MESH_AXES, MeshSpec, batch_sharding, cpu_mesh,
+                                         get_data_parallel_world_size, get_expert_parallel_world_size,
+                                         get_mesh, get_model_parallel_world_size,
+                                         get_pipe_parallel_world_size,
+                                         get_sequence_parallel_world_size, has_mesh, replicated,
+                                         reset_mesh, set_mesh)

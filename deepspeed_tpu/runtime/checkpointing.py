@@ -1,0 +1,145 @@
+"""Engine checkpoint save/load.
+
+Reference: ``engine.save_checkpoint`` (``engine.py:2816``) writes per-rank
+``mp_rank_XX_model_states.pt`` + ``*_optim_states.pt`` files plus a
+``latest`` tag file; ``load_checkpoint`` (``engine.py:2511``) restores
+module → optimizer → scheduler and supports elastic dp-resize.
+
+TPU-native: one Orbax/tensorstore checkpoint per tag holding the sharded
+params + optimizer state with sharding metadata, so loading under a
+*different* mesh (dp resize, stage change) is reshard-on-restore — the
+capability the reference implements with its ``deepspeed/checkpoint``
+reshaping tools falls out of the storage format here.  Layout:
+
+    save_dir/
+      latest                      <- text file with the newest tag
+      <tag>/
+        state/                    <- orbax pytree (params, opt, scaler, counters)
+        client_state.json         <- user client_state + engine counters
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+LATEST_FILE = "latest"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def _engine_tree(engine) -> Dict[str, Any]:
+    return {
+        "params": engine.state.params,
+        "opt_state": engine.state.opt_state,
+        "scaler": engine.state.scaler._asdict(),
+        "skipped": engine.state.skipped,
+    }
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[Dict] = None, save_latest: bool = True):
+    tag = tag if tag is not None else f"global_step{engine.global_steps}"
+    tag = str(tag)
+    ckpt_dir = os.path.join(save_dir, tag)
+    os.makedirs(save_dir, exist_ok=True)
+
+    state_path = os.path.join(ckpt_dir, "state")
+    _checkpointer().save(state_path, _engine_tree(engine), force=True)
+
+    meta = {
+        "global_steps": engine.global_steps,
+        "global_samples": engine.global_samples,
+        "micro_steps": engine.micro_steps,
+        "client_state": client_state or {},
+        "lr_scheduler": (engine.lr_scheduler.state_dict()
+                         if engine.lr_scheduler is not None and hasattr(engine.lr_scheduler, "state_dict")
+                         else None),
+        "zero_stage": engine.zero_optimization_stage(),
+        "world_size": int(np.prod(list(engine.mesh.shape.values()))),
+        "mesh_shape": {k: int(v) for k, v in engine.mesh.shape.items()},
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
+            json.dump(meta, f)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(tag)
+    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+    return True
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
+                    load_module_only: bool = False):
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.isfile(latest):
+            logger.warning(f"no 'latest' file at {latest}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    state_path = os.path.join(ckpt_dir, "state")
+    if not os.path.isdir(state_path):
+        logger.warning(f"checkpoint {ckpt_dir} not found")
+        return None, {}
+
+    # Restore with the *current* engine shardings — a different mesh/stage
+    # than at save time reshards on read (elastic checkpointing,
+    # reference ``engine.py:735`` / ``deepspeed/checkpoint``).
+    import orbax.checkpoint as ocp
+    target = {
+        "params": _abstract(engine.state.params, engine.param_shardings),
+        "opt_state": _abstract(engine.state.opt_state, engine.opt_shardings),
+        "scaler": jax.tree.map(_abstract_leaf_replicated(engine), engine.state.scaler._asdict()),
+        "skipped": _abstract_leaf_replicated(engine)(engine.state.skipped),
+    }
+    restored = _checkpointer().restore(state_path, target)
+
+    engine.state.params = restored["params"]
+    if load_optimizer_states and not load_module_only:
+        engine.state.opt_state = restored["opt_state"]
+    from deepspeed_tpu.runtime.fp16.loss_scaler import LossScalerState
+    engine.state.scaler = LossScalerState(**restored["scaler"])
+    engine.state.skipped = restored["skipped"]
+
+    meta_path = os.path.join(ckpt_dir, "client_state.json")
+    client_state = {}
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        engine.global_steps = meta.get("global_steps", 0)
+        engine.global_samples = meta.get("global_samples", 0)
+        engine.micro_steps = meta.get("micro_steps", 0)
+        client_state = meta.get("client_state", {})
+        if (load_lr_scheduler_states and engine.lr_scheduler is not None
+                and meta.get("lr_scheduler") is not None
+                and hasattr(engine.lr_scheduler, "load_state_dict")):
+            engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    log_dist(f"loaded checkpoint {ckpt_dir} at step {engine.global_steps}", ranks=[0])
+    return ckpt_dir, client_state
+
+
+def _abstract(tree, shardings):
+    return jax.tree.map(
+        lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=s),
+        tree, shardings)
+
+
+def _abstract_leaf_replicated(engine):
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(engine.mesh, PartitionSpec())
+
+    def fn(leaf):
+        return jax.ShapeDtypeStruct(jnp.shape(leaf), jnp.asarray(leaf).dtype, sharding=repl)
+
+    return fn

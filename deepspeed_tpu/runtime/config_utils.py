@@ -1,0 +1,113 @@
+"""Typed-config plumbing.
+
+Equivalent of the reference's ``deepspeed/runtime/config_utils.py``:
+``DeepSpeedConfigModel`` — a pydantic base class whose fields may carry
+deprecated aliases, and which tolerates (but records) unknown keys.  Built on
+pydantic v2 (the reference used v1; the surface kept here is what the rest of
+the codebase relies on: ``get_config_default``, dict-style construction,
+"auto" passthrough).
+"""
+
+from functools import reduce
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from deepspeed_tpu.utils.logging import logger
+
+AUTO_VALUE = "auto"
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all sub-configs parsed out of the user's JSON document.
+
+    Extra keys are allowed (collected into ``model_extra``) so that a config
+    written for the reference implementation parses here; unknown keys are
+    logged once instead of failing hard.
+    """
+
+    model_config = ConfigDict(
+        extra="allow",
+        populate_by_name=True,
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data: Any) -> None:
+        if not strict:
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+        extra = getattr(self, "model_extra", None) or {}
+        for key in extra:
+            logger.debug(f"Config key {key}={extra[key]} not recognized; carried as-is.")
+
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def dict(self, **kwargs):  # pydantic-v1-style alias used around the codebase
+        return self.model_dump(**kwargs)
+
+
+def get_config_default(config, field_name):
+    field = config.model_fields[field_name]
+    assert not field.is_required(), f"'{field_name}' is required and has no default"
+    return field.get_default()
+
+
+class pp_int(int):
+    """Int that pretty-prints with thousands separators or a custom string
+    (reference ``config_utils.py:pp_int``); used for huge default values in
+    docs/autotuning output."""
+
+    def __new__(cls, val, custom_print_str=None):
+        inst = super().__new__(cls, val)
+        inst.custom_print_str = custom_print_str
+        return inst
+
+    def __repr__(self):
+        if self.custom_print_str:
+            return self.custom_print_str
+        return f"{self.real:,}"
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json.load object_pairs_hook that rejects duplicate keys (reference
+    ``config_utils.py:dict_raise_error_on_duplicate_keys``)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+class ScientificNotationEncoder:
+    """Placeholder kept for API parity with the reference json encoder."""
+
+
+def deep_get(d: Dict, path: str, default=None):
+    """``deep_get(cfg, "zero_optimization.stage")`` dotted lookup."""
+    try:
+        return reduce(lambda acc, k: acc[k], path.split("."), d)
+    except (KeyError, TypeError):
+        return default

@@ -1,0 +1,119 @@
+"""Data loading.
+
+Reference: ``deepspeed/runtime/dataloader.py`` — ``RepeatingLoader:17`` and
+``DeepSpeedDataLoader:41`` (a torch DataLoader wired to a distributed sampler
+over DP ranks).  TPU-native: a host-side batcher that yields *global* batches;
+each process loads only its shard of every batch and the loader assembles a
+globally-sharded ``jax.Array`` over the mesh's batch axes.
+"""
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference
+    ``dataloader.py:17``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def _default_collate(samples):
+    """Stack a list of samples (tuples/dicts/arrays) into batch arrays."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([s[i] for s in samples]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: _default_collate([s[k] for s in samples]) for k in first}
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset into globally-sharded device arrays
+    (reference ``DeepSpeedDataLoader``, ``dataloader.py:41``).
+
+    ``batch_size`` is the *global* batch (micro_batch * dp_world).  In a
+    multi-process run each process materializes only its slice and the
+    global array is assembled with
+    ``multihost_utils.host_local_array_to_global_array``.
+    """
+
+    def __init__(self, dataset, batch_size: int, collate_fn: Optional[Callable] = None,
+                 mesh=None, drop_last: bool = True, shuffle: bool = True, seed: int = 0,
+                 to_device: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.mesh = mesh
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.to_device = to_device
+        self._epoch = 0
+        self._seed = seed
+        self.len = len(dataset) // batch_size if drop_last else -(-len(dataset) // batch_size)
+
+    def __len__(self):
+        return self.len
+
+    def set_epoch(self, epoch: int):
+        self._epoch = epoch
+
+    def _order(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def __iter__(self):
+        order = self._order()
+        nproc = jax.process_count()
+        pidx = jax.process_index()
+        mesh = self.mesh if self.mesh is not None else (
+            mesh_lib.get_mesh() if mesh_lib.has_mesh() else None)
+        for b in range(self.len):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                break
+            # each process loads only its contiguous shard of the batch
+            if nproc > 1:
+                per = len(idx) // nproc
+                idx = idx[pidx * per:(pidx + 1) * per]
+            batch = self.collate_fn([self.dataset[int(i)] for i in idx])
+            if not self.to_device or mesh is None:
+                yield batch
+                continue
+            sharding = NamedSharding(mesh, PartitionSpec(mesh_lib.BATCH_AXES))
+
+            def put(x):
+                if nproc > 1:
+                    from jax.experimental import multihost_utils
+                    return multihost_utils.host_local_array_to_global_array(
+                        np.asarray(x), mesh, sharding.spec)
+                return jax.device_put(jnp.asarray(x), sharding)
+
+            yield jax.tree.map(put, batch)
+        self._epoch += 1

@@ -1,0 +1,701 @@
+"""DeepSpeedEngine — the central training wrapper, TPU-native.
+
+Reference: ``deepspeed/runtime/engine.py`` (class at :183; ``forward:1652``,
+``backward:1793``, ``step:1989``, ``_take_model_step:1924``,
+``save_checkpoint:2816``, ``load_checkpoint:2511``).
+
+TPU-first redesign:
+
+* The engine owns a ``jax.sharding.Mesh`` and holds fp32 master parameters /
+  optimizer state as globally-sharded ``jax.Array``s placed by the ZeRO
+  sharding policy (``runtime/zero/policy.py``).  There are no autograd
+  hooks, buckets, or side streams: XLA-SPMD inserts the all-reduce /
+  reduce-scatter / all-gather collectives that the reference hand-schedules,
+  and its latency-hiding scheduler overlaps them with compute.
+
+* ``forward``/``backward``/``step`` keep the reference's micro-step
+  semantics (including gradient-accumulation boundaries and fp16 overflow
+  skipping) but each maps onto jitted programs:
+  - ``forward``  : in train mode runs fused value_and_grad (loss returned,
+    grads cached); in eval mode a forward-only program.
+  - ``backward`` : folds the cached gradients into the accumulation buffer
+    (sharded per ZeRO stage) — the analogue of the IPG bucketing of
+    ``stage_1_and_2.py:827``.
+  - ``step``     : at the boundary runs one compiled update program:
+    unscale → global-norm clip → overflow check → optimizer → loss-scale
+    update, with all state donated (buffers update in place).
+
+* ``train_batch(...)`` additionally offers a fully fused path: the whole
+  gradient-accumulation loop is one XLA program (``lax.scan`` over
+  micro-batches) so gradients are reduced exactly once per optimizer step —
+  the TPU equivalent of ZeRO-1's deferred bucketing, with zero Python in the
+  hot loop.
+"""
+
+import os
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScalerState, create_loss_scaler, has_overflow,
+                                                    unit_loss_scaler, update_scale)
+from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+from deepspeed_tpu.runtime.optimizers import get_optimizer
+from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
+                                       FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER,
+                                       STEP_MICRO_TIMER, NoopTimer, SynchronizedWallClockTimer,
+                                       ThroughputTimer)
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500000000
+
+
+def split_half_float_double_sparse(tensors):  # parity shim
+    return [("dense", tensors)]
+
+
+class EngineState:
+    """All device-resident training state (a mutable holder of pytrees)."""
+
+    def __init__(self):
+        self.params = None        # fp32 master params
+        self.opt_state = None
+        self.grad_acc = None      # accumulation buffer (None when empty)
+        self.scaler: LossScalerState = None
+        self.skipped = None       # device i32 counter of skipped (overflow) steps
+
+
+class DeepSpeedEngine:
+    """JSON-configured training engine (reference ``engine.py:183``)."""
+
+    def __init__(self,
+                 args=None,
+                 model=None,
+                 optimizer=None,
+                 model_parameters=None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 mpu=None,
+                 dist_init_required=None,
+                 collate_fn=None,
+                 config=None,
+                 config_class: Optional[DeepSpeedConfig] = None,
+                 dont_change_device=False,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 example_batch=None,
+                 seed: int = 42):
+        assert model is not None, "deepspeed_tpu.initialize requires a model"
+        dist.init_distributed(dist_init_required=dist_init_required)
+
+        self._config = config_class if config_class is not None else DeepSpeedConfig(
+            config if config is not None else getattr(args, "deepspeed_config", None))
+        self.training_dataloader = None
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+        self.module = model
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+
+        # ---- mesh ---------------------------------------------------- #
+        if mesh is None:
+            spec = mesh_lib.MeshSpec.from_config(self._config)
+            mesh = spec.build()
+            mesh_lib.set_mesh(mesh, spec)
+        else:
+            mesh_lib.set_mesh(mesh)
+        self.mesh = mesh
+        # Explicit mesh may differ from jax.device_count(); re-solve batches.
+        self._config.resolve_batch_size(int(np.prod(list(mesh.shape.values()))))
+
+        # ---- precision ------------------------------------------------ #
+        self.fp16_enabled = self._config.fp16_config.enabled
+        self.bfloat16_enabled = self._config.bfloat16_config.enabled
+        self.compute_dtype = self._config.precision_dtype
+
+        # ---- ZeRO policy ---------------------------------------------- #
+        zc = self._config.zero_config
+        self.zero_policy = ZeroShardingPolicy(mesh, zc.stage, min_size=int(zc.param_shard_min_size))
+
+        # ---- loss / model adapters ------------------------------------ #
+        self._loss_fn = self._make_loss_fn(model)
+        self._rng = jax.random.PRNGKey(seed)
+
+        # ---- state ----------------------------------------------------- #
+        self.state = EngineState()
+        self._init_parameters(model, model_parameters)
+
+        # ---- optimizer + scheduler ------------------------------------ #
+        self.lr_scheduler = None
+        self._schedule_fn = None
+        self._configure_lr_scheduler(lr_scheduler)
+        self.optimizer_name_ = (self._config.optimizer_name if self.client_optimizer is None
+                                else "client")
+        self._configure_optimizer()
+
+        # ---- loss scaling --------------------------------------------- #
+        if self.fp16_enabled:
+            fc = self._config.fp16_config
+            self.state.scaler = create_loss_scaler(
+                static_loss_scale=fc.loss_scale,
+                initial_scale_power=fc.initial_scale_power,
+                loss_scale_window=fc.loss_scale_window,
+                min_loss_scale=fc.min_loss_scale,
+                hysteresis=fc.hysteresis)
+        else:
+            self.state.scaler = unit_loss_scaler()
+        self.state.scaler = jax.device_put(self.state.scaler,
+                                           NamedSharding(self.mesh, PartitionSpec()))
+        self.state.skipped = jax.device_put(jnp.zeros((), jnp.int32),
+                                            NamedSharding(self.mesh, PartitionSpec()))
+
+        # ---- counters -------------------------------------------------- #
+        self.micro_steps = 0
+        self.global_steps = 0
+        self.global_samples = 0
+        self._cached_grads = None
+        self._cached_loss = None
+        self.warn_unscaled_loss = True
+        self._in_training_mode = True
+        self._step_stats: Dict[str, Any] = {}
+
+        # ---- timers / monitor ----------------------------------------- #
+        self.wall_clock_breakdown_enabled = self._config.wall_clock_breakdown
+        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown_enabled else NoopTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self._config.steps_per_print or 50)
+        self.monitor = None
+        if self._config.monitor_enabled:
+            from deepspeed_tpu.monitor.monitor import MonitorMaster
+            self.monitor = MonitorMaster(self._config)
+        self.comms_logger = None
+        if self._config.comms_config.enabled:
+            from deepspeed_tpu.utils.comms_logging import CommsLogger
+            self.comms_logger = CommsLogger(self._config.comms_config)
+            dist.configure_comms_logger(self.comms_logger)
+
+        # flops profiler
+        self.flops_profiler = None
+        if self._config.flops_profiler_config.enabled:
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(self)
+
+        # progressive layer drop
+        self.progressive_layer_drop = None
+        if self._config.pld_config.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self._config.pld_config.theta, gamma=self._config.pld_config.gamma)
+
+        # ---- dataloader ------------------------------------------------ #
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
+
+        # ---- compiled programs (built lazily per batch structure) ------ #
+        self._grad_step = None
+        self._eval_step = None
+        self._apply_step = None
+        self._acc_step = None
+        self._fused_step = None
+
+        log_dist(f"DeepSpeedEngine ready: mesh={dict(mesh.shape)}, zero_stage={zc.stage}, "
+                 f"dtype={self.compute_dtype.__name__}, "
+                 f"micro_batch={self.train_micro_batch_size_per_gpu()}, "
+                 f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # Model / parameter setup
+    # ------------------------------------------------------------------ #
+    def _make_loss_fn(self, model) -> Callable:
+        """Adapt the model to ``fn(params, batch, rng, train) -> loss|{loss,aux}``.
+
+        Accepted model forms:
+        * an object with ``.apply`` (flax linen style) whose call returns the
+          scalar loss — the convention our ``models/`` follow (the analogue
+          of the reference's SimpleModel returning loss in tests);
+        * a plain callable ``fn(params, batch, rng, train)``.
+        """
+        if hasattr(model, "apply"):
+            import inspect
+            try:
+                takes_train = "train" in inspect.signature(model.__call__).parameters
+            except (TypeError, ValueError):
+                takes_train = True
+
+            def fn(params, batch, rng, train):
+                variables = {"params": params}
+                args = batch if isinstance(batch, (tuple, list)) else (batch,)
+                kwargs = {"train": train} if takes_train else {}
+                rngs = {"dropout": rng, "ltd": jax.random.fold_in(rng, 1)} if train else {}
+                return model.apply(variables, *args, rngs=rngs, **kwargs)
+
+            return fn
+        assert callable(model), f"model must be callable or flax-like, got {type(model)}"
+        return model
+
+    def _init_parameters(self, model, model_parameters):
+        if model_parameters is None and hasattr(model, "init_params"):
+            model_parameters = model.init_params(self._next_rng())
+        assert model_parameters is not None, (
+            "Pass model_parameters (an initialized parameter pytree) or use a model "
+            "with .init_params(rng)")
+        # fp32 master copy, placed per ZeRO policy (stage 3 shards, else replicated)
+        params32 = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
+        self.param_shardings = self.zero_policy.param_shardings(params32)
+        self.state.params = jax.device_put(params32, self.param_shardings)
+        self.grad_shardings = self.zero_policy.grad_shardings(params32)
+        nparams = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params32))
+        self._num_params = nparams
+        log_dist(f"model parameters: {nparams:,}", ranks=[0])
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ------------------------------------------------------------------ #
+    # Optimizer / scheduler config
+    # ------------------------------------------------------------------ #
+    def _configure_lr_scheduler(self, client_lr_scheduler):
+        if self._config.scheduler_name is not None:
+            self.lr_scheduler = get_lr_schedule(self._config.scheduler_name,
+                                                self._config.scheduler_params)
+            self._schedule_fn = self.lr_scheduler.schedule_fn()
+            log_dist(f"Using DeepSpeed LR scheduler = {self._config.scheduler_name}", ranks=[0])
+        elif client_lr_scheduler is not None:
+            self.lr_scheduler = client_lr_scheduler
+            if hasattr(client_lr_scheduler, "schedule_fn"):
+                self._schedule_fn = client_lr_scheduler.schedule_fn()
+
+    def _configure_optimizer(self):
+        import optax
+        if self.client_optimizer is not None:
+            tx = self.client_optimizer
+            assert isinstance(tx, optax.GradientTransformation), (
+                "client optimizer must be an optax.GradientTransformation")
+            if not self._config.zero_allow_untested_optimizer and self._config.zero_enabled:
+                logger.warning("Using client optimizer with ZeRO; set "
+                               "zero_allow_untested_optimizer to silence")
+        else:
+            name = self._config.optimizer_name or "adam"
+            tx = get_optimizer(name, dict(self._config.optimizer_params),
+                               lr_schedule=self._schedule_fn)
+        self.tx = tx
+        opt_shapes = jax.eval_shape(tx.init, self.state.params)
+        self.opt_shardings = self.zero_policy.opt_shardings(opt_shapes, self.state.params)
+        self.opt_shardings = self._maybe_offload(self.opt_shardings)
+        self.state.opt_state = jax.jit(tx.init, out_shardings=self.opt_shardings)(self.state.params)
+
+    def _maybe_offload(self, shardings):
+        """ZeRO-Offload: place optimizer state in host memory
+        (reference ``offload_optimizer.device=cpu`` → CPUAdam path,
+        ``stage_1_and_2.py`` cpu_offload; here a memory_kind annotation and
+        XLA moves the bytes)."""
+        oc = self._config.zero_config.offload_optimizer
+        if oc is None or oc.device in (None, "none"):
+            return shardings
+        try:
+            return jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), shardings)
+        except Exception as e:
+            logger.warning(f"optimizer offload requested but unsupported on this backend: {e}")
+            return shardings
+
+    # ------------------------------------------------------------------ #
+    # Compiled step programs
+    # ------------------------------------------------------------------ #
+    def _cast_batch(self, batch):
+        """Cast floating inputs to the compute dtype (the reference casts
+        inputs in ``engine.py:_cast_inputs`` when fp16/bf16 enabled)."""
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x, batch)
+
+    def _value_and_grad(self, params, batch, rng, scale):
+        batch = self._cast_batch(batch)
+
+        def scaled_loss(p):
+            cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), p)
+            out = self._loss_fn(cast, batch, rng, True)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            return (loss.astype(jnp.float32) * scale, (loss, aux))
+
+        grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
+        return loss, grads
+
+    def _build_grad_step(self):
+        repl = NamedSharding(self.mesh, PartitionSpec())
+
+        @partial(jax.jit, out_shardings=(repl, self.grad_shardings))
+        def grad_step(params, batch, rng, scale):
+            return self._value_and_grad(params, batch, rng, scale)
+
+        return grad_step
+
+    def _build_eval_step(self):
+        @jax.jit
+        def eval_step(params, batch, rng):
+            cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), params)
+            out = self._loss_fn(cast, self._cast_batch(batch), rng, False)
+            loss, aux = (out if isinstance(out, tuple) else (out, None))
+            return loss
+
+        return eval_step
+
+    def _build_acc_step(self):
+        @partial(jax.jit, donate_argnums=(0,), out_shardings=self.grad_shardings)
+        def acc(acc_buf, grads):
+            return jax.tree.map(jnp.add, acc_buf, grads)
+
+        return acc
+
+    def _apply_updates(self, params, opt_state, grads, scaler, skipped):
+        """One optimizer step: unscale, clip, overflow-gate, update, rescale.
+
+        The reference splits this across ``_take_model_step:1924`` and each
+        optimizer's ``step``; here it is a single XLA program with donated
+        buffers.
+        """
+        gas = self.gradient_accumulation_steps()
+        inv = 1.0 / (scaler.scale * gas)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+
+        overflow = has_overflow(grads) if self.fp16_enabled else jnp.asarray(False)
+
+        # global grad norm (across every shard — XLA inserts the reductions)
+        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+        grad_norm = jnp.sqrt(sq)
+        clip = self.gradient_clipping()
+        if clip and clip > 0:
+            factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+            grads = jax.tree.map(lambda g: g * factor, grads)
+
+        def do_step(args):
+            params, opt_state, grads = args
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            return jax.tree.map(lambda p, u: (p + u).astype(p.dtype), params, updates), new_opt
+
+        def skip_step(args):
+            params, opt_state, _ = args
+            return params, opt_state
+
+        new_params, new_opt = jax.lax.cond(overflow, skip_step, do_step,
+                                           (params, opt_state, grads))
+        new_scaler = update_scale(scaler, overflow)
+        new_skipped = skipped + overflow.astype(jnp.int32)
+        stats = {"grad_norm": grad_norm, "overflow": overflow, "loss_scale": new_scaler.scale}
+        return new_params, new_opt, new_scaler, new_skipped, stats
+
+    def _build_apply_step(self):
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        out_shardings = (self.param_shardings, self.opt_shardings, jax.tree.map(lambda _: repl, self.state.scaler),
+                         repl, {"grad_norm": repl, "overflow": repl, "loss_scale": repl})
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4), out_shardings=out_shardings)
+        def apply_step(params, opt_state, acc, scaler, skipped):
+            return self._apply_updates(params, opt_state, acc, scaler, skipped)
+
+        return apply_step
+
+    def _build_fused_step(self):
+        """Whole train batch in one program: scan over GAS micro-batches,
+        single gradient reduction, one update (the peak-throughput path)."""
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        out_shardings = ((self.param_shardings, self.opt_shardings,
+                          jax.tree.map(lambda _: repl, self.state.scaler), repl), repl,
+                         {"grad_norm": repl, "overflow": repl, "loss_scale": repl})
+
+        @partial(jax.jit, donate_argnums=(0,), out_shardings=out_shardings)
+        def fused(carry, batches, rng):
+            params, opt_state, scaler, skipped = carry
+
+            def micro(acc_loss, xs):
+                batch, r = xs
+                loss, grads = self._value_and_grad(params, batch, r, scaler.scale)
+                acc, loss_sum = acc_loss
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return (acc, loss_sum + loss), None
+
+            gas = jax.tree.leaves(batches)[0].shape[0]
+            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            rngs = jax.random.split(rng, gas)
+            (grads, loss_sum), _ = jax.lax.scan(micro, (zeros, jnp.zeros((), jnp.float32)),
+                                                (batches, rngs))
+            new_params, new_opt, new_scaler, new_skipped, stats = self._apply_updates(
+                params, opt_state, grads, scaler, skipped)
+            return (new_params, new_opt, new_scaler, new_skipped), loss_sum / gas, stats
+
+        return fused
+
+    # ------------------------------------------------------------------ #
+    # Public training API (reference semantics)
+    # ------------------------------------------------------------------ #
+    def train(self, mode: bool = True):
+        self._in_training_mode = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def _place_batch(self, batch):
+        sharding = mesh_lib.batch_sharding(self.mesh)
+
+        def put(x):
+            x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                return multihost_utils.host_local_array_to_global_array(x, self.mesh,
+                                                                        sharding.spec)
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(put, batch)
+
+    def forward(self, *inputs, **kwargs):
+        """Compute loss on a micro-batch (reference ``engine.py:1652``).
+
+        In train mode this also computes gradients (fused forward+backward —
+        on TPU the reverse pass is part of the same XLA program and there is
+        no way, nor any reason, to run it separately); ``backward`` then
+        accumulates them.
+        """
+        batch = inputs if len(inputs) != 1 else inputs[0]
+        batch = self._place_batch(batch)
+        if self.flops_profiler:
+            self.flops_profiler.start_profile(batch)
+        self.timers(FORWARD_MICRO_TIMER).start(sync=False)
+
+        if self._in_training_mode:
+            if self._grad_step is None:
+                self._grad_step = self._build_grad_step()
+            loss, grads = self._grad_step(self.state.params, batch, self._next_rng(),
+                                          self.state.scaler.scale)
+            self._cached_grads = grads
+            self._cached_loss = loss
+        else:
+            if self._eval_step is None:
+                self._eval_step = self._build_eval_step()
+            loss = self._eval_step(self.state.params, batch, self._next_rng())
+            self._cached_loss = loss
+
+        self.timers(FORWARD_MICRO_TIMER).stop(sync=False)
+        return loss
+
+    def backward(self, loss, allreduce_gradients=True, release_loss=False):
+        """Fold the micro-batch gradients into the accumulation buffer
+        (reference ``engine.py:1793``; the allreduce/reduce-scatter is
+        decided by the gradient shardings, see ZeroShardingPolicy)."""
+        assert self._in_training_mode, "backward called in eval mode"
+        assert self._cached_grads is not None, "backward() must follow forward()"
+        self.timers(BACKWARD_MICRO_TIMER).start(sync=False)
+        if self.state.grad_acc is None:
+            # grads are already fp32 and placed by the grad_step out_shardings
+            self.state.grad_acc = self._cached_grads
+        else:
+            if self._acc_step is None:
+                self._acc_step = self._build_acc_step()
+            self.state.grad_acc = self._acc_step(self.state.grad_acc, self._cached_grads)
+        self._cached_grads = None
+        self.micro_steps += 1
+        self.timers(BACKWARD_MICRO_TIMER).stop(sync=False)
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        """True when the next ``step`` applies the optimizer (reference
+        ``engine.py:is_gradient_accumulation_boundary``)."""
+        return self.micro_steps % self.gradient_accumulation_steps() == 0
+
+    def step(self, lr_kwargs=None):
+        """Optimizer step at GAS boundaries (reference ``engine.py:1989``)."""
+        self.timers(STEP_MICRO_TIMER).start(sync=False)
+        if self.is_gradient_accumulation_boundary() and self.state.grad_acc is not None:
+            if self._apply_step is None:
+                self._apply_step = self._build_apply_step()
+            (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped,
+             stats) = self._apply_step(self.state.params, self.state.opt_state,
+                                       self.state.grad_acc, self.state.scaler,
+                                       self.state.skipped)
+            self.state.grad_acc = None
+            self._step_stats = stats
+            self._advance_step_counters(stats)
+        self.timers(STEP_MICRO_TIMER).stop(sync=False)
+
+    def _advance_step_counters(self, stats):
+        """On an fp16 overflow the optimizer update was skipped inside the
+        compiled program (the optax count did not advance), so the scheduler
+        and global_steps must not advance either — otherwise the logged lr
+        drifts from the applied lr.  Only the fp16 path pays the host sync
+        to read the overflow flag."""
+        overflow = bool(stats["overflow"]) if self.fp16_enabled else False
+        self.global_samples += self.train_batch_size()
+        if overflow:
+            log_dist(f"fp16 overflow — step skipped, new loss scale "
+                     f"{float(stats['loss_scale'])}", ranks=[0])
+        else:
+            self.global_steps += 1
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            self._report_progress()
+
+    def train_batch(self, data_iter=None, batch=None):
+        """One full optimizer step over GAS micro-batches in a single XLA
+        program.  ``batch`` leaves must have leading dim [gas, micro, ...],
+        or ``data_iter`` yields GAS micro-batches."""
+        if batch is None:
+            micro_batches = [next(data_iter) for _ in range(self.gradient_accumulation_steps())]
+            batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micro_batches)
+        batch = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x),
+                                     NamedSharding(self.mesh, PartitionSpec(None, mesh_lib.BATCH_AXES))),
+            batch)
+        if self._fused_step is None:
+            self._fused_step = self._build_fused_step()
+        self.tput_timer.start()
+        carry = (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped)
+        carry, loss, stats = self._fused_step(carry, batch, self._next_rng())
+        (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped) = carry
+        self._step_stats = stats
+        self.micro_steps += self.gradient_accumulation_steps()
+        self._advance_step_counters(stats)
+        self.tput_timer.stop(global_step=True)
+        return loss
+
+    def eval_batch(self, batch):
+        batch = self._place_batch(batch)
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        return self._eval_step(self.state.params, batch, self._next_rng())
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    def allreduce_gradients(self, bucket_size=MEMORY_OPT_ALLREDUCE_SIZE):
+        """No-op: gradient reduction is inserted by XLA-SPMD according to the
+        gradient shardings (reference ``engine.py:1774`` does it by hand)."""
+
+    # ------------------------------------------------------------------ #
+    # Introspection / config property surface (reference engine.py:479-857)
+    # ------------------------------------------------------------------ #
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def zero_optimization_stage(self):
+        return self._config.zero_config.stage
+
+    def zero_optimization(self):
+        return self._config.zero_enabled
+
+    def get_lr(self):
+        if self._schedule_fn is not None:
+            return [float(self._schedule_fn(self.global_steps))]
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_lr"):
+            return self.lr_scheduler.get_lr()
+        return [float(self._config.optimizer_params.get("lr", 0.0))]
+
+    def get_global_grad_norm(self):
+        s = self._step_stats.get("grad_norm")
+        return float(s) if s is not None else 0.0
+
+    @property
+    def skipped_steps(self):
+        return int(self.state.skipped)
+
+    def loss_scale(self):
+        return float(self.state.scaler.scale)
+
+    @property
+    def cur_scale(self):
+        return self.loss_scale()
+
+    def get_mesh(self):
+        return self.mesh
+
+    @property
+    def config(self):
+        return self._config
+
+    def wall_clock_breakdown(self):
+        return self.wall_clock_breakdown_enabled
+
+    def monitor_enabled(self):
+        return self._config.monitor_enabled
+
+    def _report_progress(self):
+        spp = self._config.steps_per_print
+        if spp and self.global_steps % spp == 0:
+            lr = self.get_lr()
+            log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, lr={lr}, "
+                     f"loss_scale={self.loss_scale()}", ranks=[0])
+            if self.monitor is not None:
+                events = [("Train/Samples/lr", lr[0], self.global_samples)]
+                if self._cached_loss is not None:
+                    events.append(("Train/Samples/train_loss", float(jnp.mean(self._cached_loss)),
+                                   self.global_samples))
+                self.monitor.write_events(events)
+        if self.wall_clock_breakdown_enabled and spp and self.global_steps % spp == 0:
+            self.timers.log([FORWARD_MICRO_TIMER, BACKWARD_MICRO_TIMER, STEP_MICRO_TIMER])
+
+    # ------------------------------------------------------------------ #
+    # Dataloader (reference engine.deepspeed_io:1560)
+    # ------------------------------------------------------------------ #
+    def deepspeed_io(self, dataset, batch_size=None, route="train", pin_memory=True,
+                     data_sampler=None, collate_fn=None, num_local_io_workers=None):
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size or self.train_micro_batch_size_per_gpu() *
+            mesh_lib.get_data_parallel_world_size(),
+            collate_fn=collate_fn or self.collate_fn,
+            mesh=self.mesh)
+
+    # ------------------------------------------------------------------ #
+    # Checkpointing (reference engine.py:2816/2511)
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        exclude_frozen_parameters=False):
+        from deepspeed_tpu.runtime.checkpointing import save_checkpoint as _save
+        return _save(self, save_dir, tag=tag, client_state=client_state or {},
+                     save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True,
+                        load_module_only=False, custom_load_fn=None):
+        from deepspeed_tpu.runtime.checkpointing import load_checkpoint as _load
+        return _load(self, load_dir, tag=tag,
+                     load_optimizer_states=load_optimizer_states,
+                     load_lr_scheduler_states=load_lr_scheduler_states,
+                     load_module_only=load_module_only)
+
+    # ------------------------------------------------------------------ #
+    def get_fp32_params(self):
+        """Gathered fp32 parameter pytree (reference
+        ``_zero3_consolidated_16bit_state_dict:3145`` analogue: an
+        un-sharded host copy)."""
+        repl = jax.tree.map(lambda _: NamedSharding(self.mesh, PartitionSpec()),
+                            self.state.params)
+        gathered = jax.jit(lambda p: p, out_shardings=repl)(self.state.params)
+        return jax.device_get(gathered)
+
+    def save_16bit_model(self, save_dir, save_filename="model.safetensors"):
+        import numpy as _np
+        os.makedirs(save_dir, exist_ok=True)
+        params = self.get_fp32_params()
+        # portable numpy .npz export (safetensors not guaranteed in image)
+        leaves, treedef = jax.tree.flatten(params)
+        _np.savez(os.path.join(save_dir, "model_16bit.npz"),
+                  **{f"p{i}": _np.asarray(l, _np.float16) for i, l in enumerate(leaves)})
+        return os.path.join(save_dir, "model_16bit.npz")

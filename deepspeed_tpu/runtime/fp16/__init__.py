@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.fp16.loss_scaler import (CreateLossScaler, DynamicLossScaler, LossScaler,
+                                                    LossScalerState, create_loss_scaler, has_overflow,
+                                                    unit_loss_scaler, update_scale)

@@ -1,0 +1,148 @@
+"""Loss scaling for fp16 training.
+
+Functional re-design of the reference's ``runtime/fp16/loss_scaler.py``
+(``LossScaler:60``, ``DynamicLossScaler:89``, factory ``:202``): the scaler
+is an immutable pytree state threaded through the jitted step, updated with
+``lax``-friendly arithmetic so the overflow check/skip lives *inside* the
+compiled program (no host sync per step).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScalerState(NamedTuple):
+    """Carried inside the train step. ``scale`` is f32; counters are i32."""
+    scale: jnp.ndarray          # current loss scale
+    good_steps: jnp.ndarray     # consecutive overflow-free steps
+    hysteresis: jnp.ndarray     # remaining tolerated overflows before backoff
+    # static config (kept as arrays so the state is a uniform pytree)
+    scale_window: jnp.ndarray
+    min_scale: jnp.ndarray
+    scale_factor: jnp.ndarray
+    delayed_shift: jnp.ndarray
+    dynamic: jnp.ndarray        # bool: False => static scale, never updates
+
+
+def create_loss_scaler(*, static_loss_scale: float = 0.0, initial_scale_power: int = 16,
+                       loss_scale_window: int = 1000, min_loss_scale: float = 1.0,
+                       hysteresis: int = 2, scale_factor: float = 2.0) -> LossScalerState:
+    """``static_loss_scale > 0`` selects a fixed scale (reference
+    ``CreateLossScaler``/``loss_scaler.py:202``); 0 selects dynamic scaling
+    starting at ``2**initial_scale_power``."""
+    dynamic = static_loss_scale == 0
+    scale = float(2.0**initial_scale_power) if dynamic else float(static_loss_scale)
+    return LossScalerState(
+        scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+        scale_window=jnp.asarray(loss_scale_window, jnp.int32),
+        min_scale=jnp.asarray(min_loss_scale, jnp.float32),
+        scale_factor=jnp.asarray(scale_factor, jnp.float32),
+        delayed_shift=jnp.asarray(hysteresis, jnp.int32),
+        dynamic=jnp.asarray(dynamic, jnp.bool_),
+    )
+
+
+def unit_loss_scaler() -> LossScalerState:
+    """Identity scaler used for bf16/fp32 paths (keeps one step signature)."""
+    return create_loss_scaler(static_loss_scale=1.0)
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """Global overflow check: any non-finite value in any gradient leaf.
+
+    The reference checks per-partition then all-reduces
+    (``has_overflow_serial``/``has_overflow`` in the fp16 optimizers); under
+    SPMD the reduction over sharded leaves is inserted by XLA.
+    """
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def update_scale(state: LossScalerState, overflow: jnp.ndarray) -> LossScalerState:
+    """Dynamic-scale transition (reference ``DynamicLossScaler.update_scale``,
+    ``loss_scaler.py:139``-ish): on overflow consume hysteresis then halve
+    (floored at min_scale) and reset the window; otherwise grow 2x every
+    ``scale_window`` clean steps."""
+
+    def on_overflow(s: LossScalerState) -> LossScalerState:
+        new_hyst = jnp.maximum(s.hysteresis - 1, 0)
+        do_backoff = new_hyst <= 0
+        new_scale = jnp.where(do_backoff,
+                              jnp.maximum(s.scale / s.scale_factor, s.min_scale),
+                              s.scale)
+        new_hyst = jnp.where(do_backoff, s.delayed_shift, new_hyst)
+        return s._replace(scale=new_scale, good_steps=jnp.zeros_like(s.good_steps),
+                          hysteresis=new_hyst)
+
+    def on_success(s: LossScalerState) -> LossScalerState:
+        grown = (s.good_steps + 1) % s.scale_window == 0
+        new_scale = jnp.where(grown, s.scale * s.scale_factor, s.scale)
+        return s._replace(scale=new_scale, good_steps=s.good_steps + 1)
+
+    new_state = jax.lax.cond(overflow, on_overflow, on_success, state)
+    # Static scalers never change.
+    return jax.tree.map(lambda new, old: jnp.where(state.dynamic, new, old), new_state, state)
+
+
+# Object-style veneer for API parity with the reference ------------------- #
+class LossScalerBase:
+
+    def __init__(self, state: LossScalerState):
+        self.state = state
+
+    @property
+    def loss_scale(self):
+        return float(self.state.scale)
+
+    def scale_gradient(self, grad):
+        return jax.tree.map(lambda g: g * self.state.scale, grad)
+
+    def backward(self, loss):
+        return loss * self.state.scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scaler."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(create_loss_scaler(static_loss_scale=scale))
+
+
+class DynamicLossScaler(LossScalerBase):
+
+    def __init__(self, init_scale=2**32, scale_factor=2.0, scale_window=1000, min_scale=1,
+                 delayed_shift=1, consecutive_hysteresis=False, raise_error_at_min_scale=True,
+                 dtype=jnp.float16):
+        import math
+        super().__init__(
+            create_loss_scaler(static_loss_scale=0.0,
+                               initial_scale_power=int(math.log2(init_scale)),
+                               loss_scale_window=scale_window, min_loss_scale=min_scale,
+                               hysteresis=delayed_shift, scale_factor=scale_factor))
+
+
+def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_args):
+    """Factory matching the reference signature (``loss_scaler.py:202``)."""
+    if dtype == jnp.float16 and dynamic_scaling:
+        kwargs = dynamic_loss_args or {}
+        return DynamicLossScaler(
+            init_scale=kwargs.get(INITIAL_LOSS_SCALE, 2**16),
+            scale_window=kwargs.get(SCALE_WINDOW, 1000),
+            min_scale=kwargs.get(MIN_LOSS_SCALE, 1),
+            delayed_shift=kwargs.get(DELAYED_SHIFT, 2),
+        )
+    loss_scale_value = static_loss_scale if dtype == jnp.float16 else 1.0
+    return LossScaler(scale=loss_scale_value)

@@ -1,0 +1,216 @@
+"""Learning-rate schedules.
+
+Same families and JSON params as the reference ``runtime/lr_schedules.py``:
+``LRRangeTest`` (:258), ``OneCycle`` (:361), ``WarmupLR`` (:626),
+``WarmupDecayLR`` (:715).  Each is exposed two ways:
+
+* as a pure ``schedule(step) -> lr`` callable handed to optax (the jitted
+  path — the optimizer derives lr from its own step count, so schedule and
+  optimizer can never drift), and
+* as a stateful object with ``step()/get_lr()/state_dict()/load_state_dict()``
+  for API parity with torch-style schedulers.
+"""
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+WARMUP_TYPE = "warmup_type"
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+TOTAL_NUM_STEPS = "total_num_steps"
+
+CYCLE_MIN_LR = "cycle_min_lr"
+CYCLE_MAX_LR = "cycle_max_lr"
+CYCLE_FIRST_STEP_SIZE = "cycle_first_step_size"
+CYCLE_SECOND_STEP_SIZE = "cycle_second_step_size"
+CYCLE_FIRST_STAIR_COUNT = "cycle_first_stair_count"
+CYCLE_SECOND_STAIR_COUNT = "cycle_second_stair_count"
+DECAY_STEP_SIZE = "decay_step_size"
+CYCLE_MIN_MOM = "cycle_min_mom"
+CYCLE_MAX_MOM = "cycle_max_mom"
+DECAY_LR_RATE = "decay_lr_rate"
+DECAY_MOM_RATE = "decay_mom_rate"
+
+
+class _ScheduleBase:
+    """Stateful veneer over a pure schedule function."""
+
+    def __init__(self, schedule_fn: Callable[[int], float]):
+        self._fn = schedule_fn
+        self.last_batch_iteration = -1
+
+    def schedule_fn(self):
+        return self._fn
+
+    def get_lr(self) -> List[float]:
+        return [float(self._fn(max(self.last_batch_iteration, 0)))]
+
+    def get_last_lr(self) -> List[float]:
+        return self.get_lr()
+
+    def step(self, last_batch_iteration: Optional[int] = None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class WarmupLR(_ScheduleBase):
+    """Warmup then hold (reference ``lr_schedules.py:626``)."""
+
+    def __init__(self, optimizer=None, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                 warmup_num_steps=1000, warmup_type=WARMUP_LOG_RATE, last_batch_iteration=-1):
+        self.warmup_min_lr = warmup_min_lr
+        self.warmup_max_lr = warmup_max_lr
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+
+        def fn(step):
+            import jax.numpy as jnp
+            step = jnp.asarray(step, jnp.float32)
+            if self.warmup_type == WARMUP_LOG_RATE:
+                gamma = self.inverse_log_warm_up * jnp.log(step + 1)
+            else:
+                gamma = step / self.warmup_num_steps
+            gamma = jnp.clip(gamma, 0.0, 1.0)
+            warm = self.warmup_min_lr + (self.warmup_max_lr - self.warmup_min_lr) * gamma
+            return jnp.where(step < self.warmup_num_steps, warm, self._post_warmup(step))
+
+        super().__init__(fn)
+        self.last_batch_iteration = last_batch_iteration
+
+    def _post_warmup(self, step: int) -> float:
+        return self.warmup_max_lr
+
+
+class WarmupDecayLR(WarmupLR):
+    """Warmup then linear decay to 0 over total_num_steps (reference
+    ``lr_schedules.py:715``)."""
+
+    def __init__(self, optimizer=None, total_num_steps=10000, warmup_min_lr=0.0,
+                 warmup_max_lr=0.001, warmup_num_steps=1000, warmup_type=WARMUP_LOG_RATE,
+                 last_batch_iteration=-1):
+        self.total_num_steps = total_num_steps
+        super().__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                         warmup_type, last_batch_iteration)
+        if self.total_num_steps < self.warmup_num_steps:
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(f"total_num_steps {total_num_steps} is less than "
+                           f"warmup_num_steps {warmup_num_steps}")
+
+    def _post_warmup(self, step):
+        import jax.numpy as jnp
+        frac = (self.total_num_steps - step) / max(1, self.total_num_steps - self.warmup_num_steps)
+        return self.warmup_max_lr * jnp.clip(frac, 0.0, 1.0)
+
+
+class LRRangeTest(_ScheduleBase):
+    """LR range test sweep (reference ``lr_schedules.py:258``)."""
+
+    def __init__(self, optimizer=None, lr_range_test_min_lr=1e-3, lr_range_test_step_size=2000,
+                 lr_range_test_step_rate=1.0, lr_range_test_staircase=False,
+                 last_batch_iteration=-1):
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+        def fn(step):
+            import jax.numpy as jnp
+            step = jnp.asarray(step, jnp.float32)
+            lr_increase = step / self.step_size
+            if self.staircase:
+                lr_increase = jnp.floor(lr_increase)
+            return self.min_lr * (1 + self.step_rate * lr_increase)
+
+        super().__init__(fn)
+        self.last_batch_iteration = last_batch_iteration
+
+
+class OneCycle(_ScheduleBase):
+    """1cycle policy: cycle up, cycle down, then decay (reference
+    ``lr_schedules.py:361``; momentum cycling folded into ``get_mom``)."""
+
+    def __init__(self, optimizer=None, cycle_min_lr=1e-5, cycle_max_lr=1e-3,
+                 decay_lr_rate=0.0, cycle_first_step_size=2000, cycle_second_step_size=None,
+                 cycle_first_stair_count=0, cycle_second_stair_count=None,
+                 decay_step_size=0, cycle_momentum=True, cycle_min_mom=0.85,
+                 cycle_max_mom=0.99, decay_mom_rate=0.0, last_batch_iteration=-1):
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_size = cycle_first_step_size
+        self.second_size = cycle_second_step_size or cycle_first_step_size
+        self.decay_step_size = decay_step_size
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+        total_size = self.first_size + self.second_size
+
+        def fn(step):
+            import jax.numpy as jnp
+            step = jnp.asarray(step, jnp.float32)
+            scale_up = step / self.first_size
+            scale_down = 1.0 - (step - self.first_size) / self.second_size
+            scale = jnp.where(step <= self.first_size, scale_up, scale_down)
+            cyc = self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * scale
+            decay_steps = step - total_size
+            denom = self.decay_step_size if self.decay_step_size > 0 else 1
+            decay_epochs = decay_steps / denom if self.decay_step_size > 0 else decay_steps
+            dec = (self.cycle_min_lr / (1.0 + self.decay_lr_rate * decay_epochs)
+                   if self.decay_lr_rate else self.cycle_min_lr)
+            return jnp.where(step <= total_size, cyc, dec)
+
+        super().__init__(fn)
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_mom(self) -> float:
+        step = max(self.last_batch_iteration, 0)
+        total_size = self.first_size + self.second_size
+        if not self.cycle_momentum:
+            return self.cycle_max_mom
+        if step <= total_size:
+            if step <= self.first_size:
+                scale = step / self.first_size
+            else:
+                scale = 1.0 - (step - self.first_size) / self.second_size
+            return self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * scale
+        return self.cycle_max_mom
+
+
+SCHEDULE_CLASSES = {
+    LR_RANGE_TEST: LRRangeTest,
+    ONE_CYCLE: OneCycle,
+    WARMUP_LR: WarmupLR,
+    WARMUP_DECAY_LR: WarmupDecayLR,
+}
+
+
+def get_lr_schedule(name: str, params: Dict[str, Any]):
+    """Instantiate from the ``scheduler`` JSON block (reference
+    ``engine.py:_scheduler_from_config``)."""
+    assert name in VALID_LR_SCHEDULES, f"{name} is not a valid LR schedule ({VALID_LR_SCHEDULES})"
+    return SCHEDULE_CLASSES[name](**params)

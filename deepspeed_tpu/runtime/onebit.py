@@ -1,0 +1,121 @@
+"""1-bit (communication-compressed) optimizers.
+
+Reference: ``deepspeed/runtime/fp16/onebit/{adam,lamb,zoadam}.py`` —
+Adam/LAMB variants that, after a full-precision warmup, communicate only the
+sign of the momentum plus a scale, keeping a local error-feedback
+(compensation) buffer.
+
+TPU-native recast: XLA owns the collectives, so the compression is applied
+to the *momentum representation* with the same error-feedback math — after
+``freeze_step`` updates use ``sign(m + e) * scale`` where ``e`` accumulates
+the quantization residual (exactly the compensated compression of
+``onebit/adam.py``; variance is frozen at the freeze step as in the
+reference).  A future comm-level path can move the sign/scale exchange into
+a shard_map reduce without changing this state.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class OneBitAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates          # momentum (m)
+    nu: optax.Updates          # second moment (frozen after freeze_step)
+    error: optax.Updates       # error-feedback buffer
+
+
+def onebit_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                freeze_step=100, use_trust_ratio=False) -> optax.GradientTransformation:
+    """1-bit Adam (reference ``onebit/adam.py:OnebitAdam:13``).
+
+    Before ``freeze_step``: exact Adam.  After: variance frozen; the update
+    direction is the compensated 1-bit momentum sign times its mean
+    magnitude (error feedback keeps the quantization unbiased over time).
+    ``use_trust_ratio`` turns this into 1-bit LAMB's layerwise scaling.
+    """
+
+    def init_fn(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return OneBitAdamState(count=jnp.zeros((), jnp.int32), mu=zeros(), nu=zeros(),
+                               error=zeros())
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        in_warmup = count <= freeze_step
+        # variance only updates during warmup (frozen afterwards)
+        nu = jax.tree.map(
+            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g), v),
+            state.nu, updates)
+
+        bc1 = 1 - b1**count.astype(jnp.float32)
+        bc2 = 1 - b2**count.astype(jnp.float32)
+
+        def adam_dir(m, v):
+            return (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+
+        def compressed_dir(m, v, e):
+            comp = m + e                                  # compensated momentum
+            scale = jnp.mean(jnp.abs(comp))
+            quant = jnp.sign(comp) * scale                # 1-bit + scale
+            new_e = comp - quant                          # error feedback
+            return quant / (jnp.sqrt(v / bc2) + eps), new_e
+
+        def choose(m, v, e):
+            d_warm = adam_dir(m, v)
+            d_comp, new_e = compressed_dir(m, v, e)
+            d = jnp.where(in_warmup, d_warm, d_comp)
+            e_out = jnp.where(in_warmup, e, new_e)
+            return d, e_out
+
+        pairs = jax.tree.map(choose, mu, nu, state.error)
+        direction = jax.tree.map(lambda p: p[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        error = jax.tree.map(lambda p: p[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+
+        lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
+
+        def scaled(d, p):
+            upd = d + weight_decay * p if (weight_decay and params is not None) else d
+            if use_trust_ratio and params is not None:
+                w_norm = jnp.linalg.norm(p)
+                u_norm = jnp.linalg.norm(upd)
+                trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+                return -lr * trust * upd
+            return -lr * upd
+
+        if params is not None:
+            new_updates = jax.tree.map(scaled, direction, params)
+        else:
+            new_updates = jax.tree.map(lambda d: -lr * d, direction)
+        return new_updates, OneBitAdamState(count=count, mu=mu, nu=nu, error=error)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def zero_one_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                  var_freeze_step=100, var_update_scaler=16, **_):
+    """0/1 Adam (reference ``onebit/zoadam.py:ZeroOneAdam:13``): like 1-bit
+    Adam but the variance keeps updating on a geometric cadence; approximated
+    here with the same freeze point (cadence policies are a host-side detail
+    the XLA program can't cheaply express)."""
+    return onebit_adam(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+                       freeze_step=var_freeze_step)
+
+
+def get_onebit_optimizer(name: str, params: dict, lr):
+    betas = params.get("betas", (0.9, 0.999))
+    kwargs = dict(b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-8),
+                  weight_decay=params.get("weight_decay", 0.0),
+                  freeze_step=params.get("freeze_step", 100))
+    if name == "onebitadam":
+        return onebit_adam(lr, **kwargs)
+    if name == "onebitlamb":
+        return onebit_adam(lr, use_trust_ratio=True, **kwargs)
+    if name == "zerooneadam":
+        kwargs.pop("freeze_step")
+        return zero_one_adam(lr, var_freeze_step=params.get("var_freeze_step", 100), **kwargs)
+    raise ValueError(name)

@@ -1,0 +1,87 @@
+"""Optimizer factory.
+
+The reference selects among torch/apex/DS-fused optimizers in
+``engine.py:_configure_basic_optimizer:1225`` (Adam/AdamW/FusedAdam/CPUAdam/
+Lamb/FusedLamb/OnebitAdam/OnebitLamb/ZeroOneAdam/Adagrad).  Here every
+optimizer is an ``optax.GradientTransformation`` — already "fused" in the
+reference's sense because the whole update jits into one XLA program over the
+parameter pytree (the multi-tensor-apply trick of
+``csrc/adam/multi_tensor_adam.cu`` is the default compilation model on TPU).
+
+CPU offload ("cpu_adam") is not a different optimizer here: the same
+transformation runs against optimizer state placed in host memory by the
+ZeRO offload policy (``runtime/zero/offload.py``).
+"""
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import optax
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM = "fusedadam"
+CPU_ADAM = "cpuadam"  # alias: same math, host-placed state
+LAMB_OPTIMIZER = "lamb"
+FUSED_LAMB = "fusedlamb"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+ADAGRAD_OPTIMIZER = "adagrad"
+SGD_OPTIMIZER = "sgd"
+MUADAM_OPTIMIZER = "muadam"
+LION_OPTIMIZER = "lion"
+
+DS_NATIVE_OPTIMIZERS = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM, CPU_ADAM, LAMB_OPTIMIZER,
+                        FUSED_LAMB, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
+                        ZERO_ONE_ADAM_OPTIMIZER, ADAGRAD_OPTIMIZER, SGD_OPTIMIZER, LION_OPTIMIZER]
+
+ScheduleOrFloat = Union[float, Callable[[int], float]]
+
+
+def _lr(params: Dict[str, Any], schedule: Optional[Callable] = None) -> ScheduleOrFloat:
+    if schedule is not None:
+        return schedule
+    return params.get("lr", 1e-3)
+
+
+def get_optimizer(name: str, params: Dict[str, Any],
+                  lr_schedule: Optional[Callable[[int], float]] = None
+                  ) -> optax.GradientTransformation:
+    """Build the optax transformation for a ds_config ``optimizer`` block.
+
+    ``lr_schedule`` (a pure fn of the update count) overrides the static
+    ``lr`` — this is how the JSON ``scheduler`` block binds to the optimizer.
+    """
+    name = name.lower()
+    lr = _lr(params, lr_schedule)
+    betas = params.get("betas", (0.9, 0.999))
+    eps = params.get("eps", 1e-8)
+    wd = params.get("weight_decay", 0.0)
+
+    if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
+        # Reference FusedAdam defaults to adam_w_mode=True (ops/adam/fused_adam.py:18)
+        adam_w_mode = params.get("adam_w_mode", True)
+        if adam_w_mode or name == ADAMW_OPTIMIZER:
+            return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+        tx = optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == ADAMW_OPTIMIZER:
+        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name in (LAMB_OPTIMIZER, FUSED_LAMB):
+        return optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name == ADAGRAD_OPTIMIZER:
+        return optax.adagrad(lr, eps=params.get("eps", 1e-10))
+    if name == SGD_OPTIMIZER:
+        tx = optax.sgd(lr, momentum=params.get("momentum", 0.0),
+                       nesterov=params.get("nesterov", False))
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == LION_OPTIMIZER:
+        return optax.lion(lr, b1=betas[0], b2=betas[1], weight_decay=wd)
+    if name in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+        from deepspeed_tpu.runtime.onebit import get_onebit_optimizer
+        return get_onebit_optimizer(name, params, lr)
+    raise ValueError(f"Unknown optimizer type: {name!r} (valid: {DS_NATIVE_OPTIMIZERS})")

@@ -1,0 +1,182 @@
+"""Pipeline model description.
+
+Reference: ``deepspeed/runtime/pipe/module.py`` — ``LayerSpec:29``,
+``TiedLayerSpec:76``, ``PipelineModule:85`` with ``_partition_layers:353``
+(uniform / parameters / type:regex balancing).
+
+TPU-native: a ``PipelineModule`` is a *description* of a layer list plus a
+partitioning; execution happens in ``pipe/engine.py`` which maps stages onto
+the ``pipe`` mesh axis and runs the 1F1B schedule inside one XLA program
+(collective-permute between stages instead of NCCL P2P).
+"""
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Delayed layer constructor (reference ``pipe/module.py:29``): stores
+    ``typename`` + args so each stage only materializes its own layers."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec typename must be callable (a module class or fn)")
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        name = getattr(self.typename, "__name__", str(self.typename))
+        return f"LayerSpec({name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared with every other layer carrying the
+    same ``key`` (reference ``pipe/module.py:76`` — embedding/unembedding
+    tying).  ``forward_fn`` lets the reuse site apply the tied params
+    differently (e.g. logits = x @ E^T)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn: Optional[Callable] = None,
+                 tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Layer-list model partitioned over pipeline stages (reference
+    ``pipe/module.py:85``)."""
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0, seed_layers: bool = False,
+                 base_seed: int = 1234):
+        self.layer_specs = [l if isinstance(l, LayerSpec) else LayerSpec(_wrap_callable(l))
+                            for l in layers]
+        self.num_stages = num_stages or 1
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.parts = None  # stage boundaries, computed by partition()
+
+    def __len__(self):
+        return len(self.layer_specs)
+
+    # ------------------------------------------------------------------ #
+    def partition(self, param_counts: Optional[List[int]] = None) -> List[int]:
+        """Compute stage boundaries (reference ``_partition_layers:353``).
+
+        Returns ``parts`` of length num_stages+1; stage ``i`` owns layers
+        ``parts[i]:parts[i+1]``.
+        """
+        method = self.partition_method.lower()
+        n = len(self.layer_specs)
+        if method == "uniform":
+            self.parts = partition_uniform(n, self.num_stages)
+        elif method == "parameters":
+            if param_counts is None:
+                param_counts = [1] * n
+            self.parts = partition_balanced(param_counts, self.num_stages)
+        elif method.startswith("type:"):
+            regex = method.split(":", 1)[1]
+            weights = [1 if re.search(regex, getattr(s.typename, "__name__", ""), re.IGNORECASE)
+                       else 0 for s in self.layer_specs]
+            self.parts = partition_balanced(weights, self.num_stages)
+        else:
+            raise NotImplementedError(f"partition method {self.partition_method}")
+        return self.parts
+
+    def stage_layers(self, stage_id: int) -> List[LayerSpec]:
+        assert self.parts is not None, "call partition() first"
+        return self.layer_specs[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    def tied_keys(self):
+        keys = {}
+        for i, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                keys.setdefault(spec.key, []).append(i)
+        return keys
+
+
+def _wrap_callable(fn):
+    class _Lambda:
+        def __init__(self):
+            self.fn = fn
+
+        def __call__(self, *a, **k):
+            return fn(*a, **k)
+
+    _Lambda.__name__ = getattr(fn, "__name__", "LambdaLayer")
+    return _Lambda
+
+
+# ------------------------------------------------------------------ #
+# Partition helpers (reference ``runtime/utils.py:partition_uniform`` and
+# ``partition_balanced`` used from pipe/module.py)
+# ------------------------------------------------------------------ #
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Minimize the heaviest part via binary search over the bottleneck
+    (reference ``ds_utils.partition_balanced`` — same contract, simpler
+    algorithm)."""
+    weights = list(weights)
+    n = len(weights)
+    if num_parts >= n:
+        return partition_uniform(n, num_parts)
+    prefix = np.concatenate([[0], np.cumsum(weights)])
+
+    def parts_for(bottleneck):
+        parts, used = 1, 0.0
+        for w in weights:
+            if w > bottleneck:
+                return None
+            if used + w > bottleneck:
+                parts += 1
+                used = w
+            else:
+                used += w
+        return parts
+
+    lo, hi = max(weights), float(prefix[-1])
+    for _ in range(64):
+        mid = (lo + hi) / 2
+        p = parts_for(mid)
+        if p is not None and p <= num_parts:
+            hi = mid
+        else:
+            lo = mid
+    # greedy assignment with bottleneck hi
+    bounds = [0]
+    used = 0.0
+    for i, w in enumerate(weights):
+        if used + w > hi + 1e-9 and len(bounds) < num_parts:
+            bounds.append(i)
+            used = w
+        else:
+            used += w
+    while len(bounds) < num_parts:
+        bounds.append(n)
+    bounds.append(n)
+    return bounds

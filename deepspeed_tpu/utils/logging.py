@@ -1,0 +1,101 @@
+"""Rank-aware logging.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``:
+``logger`` is a package-level logger, ``log_dist(msg, ranks)`` only logs on
+the listed process indices (reference: ``log_dist`` filters on
+``deepspeed.comm.get_rank()``).  Here "rank" is ``jax.process_index()``.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL_DEFAULT = logging.INFO
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name=None, level=LOG_LEVEL_DEFAULT):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    name="deepspeed_tpu",
+    level=log_levels.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+@functools.lru_cache(None)
+def _process_index():
+    # Deferred so that importing utils does not force jax backend init.
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process indices (default: all).
+
+    ``ranks=[-1]`` or ``None`` means every process; otherwise only processes
+    whose ``jax.process_index()`` is listed emit the record.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_json_dist(message, ranks=None, path=None):
+    """Dump ``message`` (a dict) as JSON to ``path`` on the listed ranks."""
+    import json
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        message["rank"] = my_rank
+        with open(path, "w") as f:
+            json.dump(message, f)
+
+
+def get_current_level():
+    return logger.getEffectiveLevel()
+
+
+def should_log_le(max_log_level_str):
+    """True when the logger's level is <= the named level (reference
+    ``utils/logging.py:should_log_le``)."""
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in log_levels:
+        raise ValueError(f"{max_log_level_str} is not one of the `logging` levels")
+    return get_current_level() <= log_levels[max_log_level_str]
+
+
+def warning_once(msg):
+    _warn_cache_once(msg)
+
+
+@functools.lru_cache(None)
+def _warn_cache_once(msg):
+    logger.warning(msg)

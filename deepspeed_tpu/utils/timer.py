@@ -1,0 +1,248 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analogue of the reference's ``deepspeed/utils/timer.py``:
+``SynchronizedWallClockTimer`` (reference ``utils/timer.py:33``) and
+``ThroughputTimer`` (reference ``utils/timer.py:137``).  Device
+synchronization is a ``jax.block_until_ready`` on a trivial computation (or a
+caller-supplied array) instead of CUDA events — on TPU all dispatch is async
+through the same stream, so draining it is an exact fence.
+"""
+
+import time
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+BACKWARD_INNER_MICRO_TIMER = "bwd_inner_microstep"
+BACKWARD_INNER_GLOBAL_TIMER = "bwd_inner"
+BACKWARD_REDUCE_MICRO_TIMER = "bwd_allreduce_microstep"
+BACKWARD_REDUCE_GLOBAL_TIMER = "bwd_allreduce"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+try:
+    import psutil
+    PSUTIL_AVAILABLE = True
+except ImportError:
+    PSUTIL_AVAILABLE = False
+
+
+def _sync_device():
+    import jax
+    # Draining dispatch: put a token op and block.  jax has no global
+    # "synchronize" API; blocking on a trivial device computation after all
+    # enqueued work is an effective fence on TPU's in-order stream.
+    jax.block_until_ready(jax.device_put(0))
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers, optionally synchronizing the device stream."""
+
+    class Timer:
+
+        def __init__(self, name):
+            self.name_ = name
+            self.started_ = False
+            self.start_time = time.time()
+            self.elapsed_records = []
+
+        def start(self, sync=False):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            if sync:
+                _sync_device()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False, record=True, sync=True):
+            assert self.started_, "timer is not started"
+            if sync:
+                _sync_device()
+            elapsed = time.time() - self.start_time
+            if record:
+                self.elapsed_records.append(elapsed)
+            self.started_ = False
+
+        def _get_elapsed_msec(self):
+            return sum(self.elapsed_records) * 1000.0
+
+        def reset(self):
+            self.started_ = False
+            self.elapsed_records = []
+
+        def elapsed(self, reset=True):
+            """Total recorded time in milliseconds."""
+            started = self.started_
+            if started:
+                self.stop(record=True)
+            elapsed = self._get_elapsed_msec()
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed
+
+        def mean(self):
+            if not self.elapsed_records:
+                return 0.0
+            return (sum(self.elapsed_records) / len(self.elapsed_records)) * 1000.0
+
+
+    def __init__(self):
+        self.timers = {}
+
+    def get_timers(self):
+        return self.timers
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        import jax
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        alloc = stats.get("bytes_in_use", 0) / (1024**3)
+        peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+        return f"DeviceMem Allocated {round(alloc, 2)} GB Max {round(peak, 2)} GB"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                means[name] = self.timers[name].mean() / normalizer
+                if reset:
+                    self.timers[name].reset()
+        return means
+
+
+class NoopTimer:
+    """Placeholder with the SynchronizedWallClockTimer interface."""
+
+    class Timer:
+
+        def start(self, **kwargs):
+            ...
+
+        def reset(self):
+            ...
+
+        def stop(self, **kwargs):
+            ...
+
+        def elapsed(self, **kwargs):
+            return 0
+
+        def mean(self):
+            return 0
+
+    def __call__(self, name):
+        return self.Timer()
+
+    def get_timers(self):
+        return {}
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        ...
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        ...
+
+
+class ThroughputTimer:
+    """Samples/sec tracker (reference ``utils/timer.py:137``).
+
+    ``batch_size`` is the *global* train batch per step.  Reports every
+    ``steps_per_output`` steps via ``log_dist``.
+    """
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn
+        if self.logging is None:
+            self.logging = log_dist
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _sync_device()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _sync_device()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, RunningAvgSamplesPerSec="
+                        f"{self.avg_samples_per_sec():.3f}, CurrSamplesPerSec="
+                        f"{self.batch_size / self.step_elapsed_time:.3f}")
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > 0 and self.total_elapsed_time > 0:
+            samples_per_step = self.batch_size
+            total_step_offset = self.global_step_count - self.start_step
+            if total_step_offset <= 0:
+                return 0.0
+            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            return samples_per_step / avg_time_per_step
+        return 0.0
+
+
+def trim_mean(data, trim_percent):
+    """Mean with the tails trimmed (used by comms logging summaries)."""
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    if n == 0:
+        return 0
+    data = sorted(data)
+    k = int(round(n * trim_percent))
+    return sum(data[k:n - k]) / max(1, n - 2 * k)

@@ -1,0 +1,32 @@
+"""Test session setup: force an 8-device virtual CPU mesh.
+
+The reference tests fork N processes over loopback NCCL
+(``tests/unit/common.py:DistributedExec:88``).  Here "distributed" tests run
+single-process SPMD over 8 virtual CPU devices — XLA's
+``--xla_force_host_platform_device_count`` — so CI needs no TPU and no
+process forking (SURVEY.md §4 "TPU translation").
+
+Note: a sitecustomize may register a TPU plugin at interpreter start, before
+this file runs; overriding ``jax_platforms`` via jax.config (not just env)
+wins as long as no backend has been instantiated yet.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    mesh_lib.reset_mesh()

@@ -1,0 +1,119 @@
+"""Config-system tests (analogue of reference
+``tests/unit/runtime/test_ds_config_dict.py`` / ``test_ds_config_model.py``)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_arithmetic_full():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+    }, world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_arithmetic_solve_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2},
+                          world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_arithmetic_solve_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 2},
+                          world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_arithmetic_solve_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2},
+                          world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_arithmetic_invalid():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 33,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+        }, world_size=8)
+
+
+def test_batch_arithmetic_missing():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_zero_config_aliases():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_max_live_parameters": 12345,
+            "stage3_prefetch_bucket_size": 777,
+            "offload_optimizer": {"device": "cpu"},
+        },
+    }, world_size=8)
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.max_live_parameters == 12345
+    assert cfg.zero_config.prefetch_bucket_size == 777
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "fp16": {"enabled": True},
+            "bf16": {"enabled": True},
+        }, world_size=8)
+
+
+def test_fp16_params():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "initial_scale_power": 8, "loss_scale_window": 500},
+    }, world_size=8)
+    assert cfg.fp16_config.enabled
+    assert cfg.fp16_config.initial_scale_power == 8
+    import jax.numpy as jnp
+    assert cfg.precision_dtype == jnp.float16
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.001, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, world_size=8)
+    assert cfg.optimizer_name == "adamw"
+    assert cfg.optimizer_params["lr"] == 0.001
+    assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_unknown_keys_tolerated():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 1, "some_future_knob": True},
+    }, world_size=8)
+    assert cfg.zero_config.stage == 1
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=8)
+
+
+def test_mesh_config():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "mesh": {"tensor": 2},
+    }, world_size=8)
+    assert cfg.mesh_config.tensor == 2
+    assert cfg.dp_world_size == 4

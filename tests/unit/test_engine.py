@@ -1,0 +1,194 @@
+"""End-to-end engine tests on the 8-device CPU mesh — the analogue of the
+reference's ZeRO/engine correctness tests (``tests/unit/runtime/zero/test_zero.py``
+stages 1/2/3 vs torch; here each stage is checked against the stage-0 loss
+trajectory, which is the same invariant)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, random_dataset
+
+HIDDEN = 64
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 0,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(cfg, nsteps=4, seed=7, fused=False):
+    import jax
+    model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+    params = model.init_params(jax.random.PRNGKey(0), batch_size=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config=cfg, seed=seed)
+    data = random_dataset(512, HIDDEN, seed=seed)
+    micro = engine.train_micro_batch_size_per_gpu()
+    dp = 8  # full mesh on CPU tests
+    losses = []
+    idx = 0
+    gas = engine.gradient_accumulation_steps()
+    global_micro = micro * dp
+
+    def next_batch():
+        nonlocal idx
+        xs = np.stack([data[(idx + i) % len(data)][0] for i in range(global_micro)])
+        ys = np.stack([data[(idx + i) % len(data)][1] for i in range(global_micro)])
+        idx += global_micro
+        return xs, ys
+
+    for _ in range(nsteps):
+        if fused:
+            batches = [next_batch() for _ in range(gas)]
+            stacked = tuple(np.stack([b[i] for b in batches]) for i in range(2))
+            loss = engine.train_batch(batch=stacked)
+            losses.append(float(loss))
+        else:
+            step_losses = []
+            for _ in range(gas):
+                loss = engine.forward(*next_batch())
+                engine.backward(loss)
+                engine.step()
+                step_losses.append(float(loss))
+            losses.append(float(np.mean(step_losses)))
+    return losses, engine
+
+
+class TestZeroStages:
+
+    @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+    def test_loss_decreases(self, stage):
+        cfg = base_config(zero_optimization={"stage": stage, "param_shard_min_size": 0})
+        losses, engine = run_steps(cfg, nsteps=4)
+        assert losses[-1] < losses[0], f"stage {stage}: loss did not decrease: {losses}"
+        assert engine.global_steps == 4
+
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_parity_with_stage0(self, stage):
+        """ZeRO stages must be numerically equivalent to plain DP."""
+        losses0, _ = run_steps(base_config(zero_optimization={"stage": 0}), nsteps=3)
+        lossesN, _ = run_steps(
+            base_config(zero_optimization={"stage": stage, "param_shard_min_size": 0}), nsteps=3)
+        np.testing.assert_allclose(losses0, lossesN, rtol=2e-4, atol=2e-5)
+
+    def test_fused_train_batch_matches_unfused(self):
+        cfg = base_config(zero_optimization={"stage": 2, "param_shard_min_size": 0})
+        l_unfused, _ = run_steps(cfg, nsteps=3, fused=False)
+        l_fused, _ = run_steps(cfg, nsteps=3, fused=True)
+        np.testing.assert_allclose(l_unfused, l_fused, rtol=2e-4, atol=2e-5)
+
+
+class TestPrecision:
+
+    def test_bf16_runs(self):
+        cfg = base_config(bf16={"enabled": True},
+                          zero_optimization={"stage": 2, "param_shard_min_size": 0})
+        losses, _ = run_steps(cfg, nsteps=4)
+        assert losses[-1] < losses[0]
+
+    def test_fp16_dynamic_scale(self):
+        cfg = base_config(fp16={"enabled": True, "initial_scale_power": 8})
+        losses, engine = run_steps(cfg, nsteps=4)
+        assert losses[-1] < losses[0]
+        assert engine.loss_scale() > 0
+
+    def test_fp16_overflow_skips_step(self):
+        import jax
+        import jax.numpy as jnp
+        cfg = base_config(fp16={"enabled": True, "initial_scale_power": 4})
+        model = SimpleModel(hidden_dim=HIDDEN)
+        params = model.init_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                   config=cfg)
+        xs = np.full((16, HIDDEN), 1e30, dtype=np.float32)  # guaranteed overflow in fp16
+        ys = np.zeros((16,), dtype=np.int32)
+        before = float(engine.state.scaler.scale)
+        for _ in range(engine.gradient_accumulation_steps()):
+            loss = engine.forward(xs, ys)
+            engine.backward(loss)
+            engine.step()
+        # one overflow consumed hysteresis or halved the scale; step skipped
+        assert engine.skipped_steps >= 1
+
+
+class TestGradClip:
+
+    def test_clipping_applied(self):
+        cfg = base_config(gradient_clipping=1e-4)
+        losses, engine = run_steps(cfg, nsteps=2)
+        assert engine.get_global_grad_norm() >= 0
+
+
+class TestScheduler:
+
+    def test_warmup_lr(self):
+        cfg = base_config(scheduler={"type": "WarmupLR",
+                                     "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                                                "warmup_num_steps": 10}})
+        losses, engine = run_steps(cfg, nsteps=3)
+        lr = engine.get_lr()[0]
+        assert 0 < lr <= 1e-2
+
+
+class TestCheckpoint:
+
+    def test_save_load_roundtrip(self, tmp_path):
+        import jax
+        cfg = base_config(zero_optimization={"stage": 2, "param_shard_min_size": 0})
+        losses, engine = run_steps(cfg, nsteps=2)
+        engine.save_checkpoint(str(tmp_path), tag="tag1", client_state={"foo": 7})
+
+        # fresh engine, load, verify state equality
+        model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+        params = model.init_params(jax.random.PRNGKey(99), batch_size=2)
+        engine2, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                    config=cfg)
+        path, client = engine2.load_checkpoint(str(tmp_path), tag="tag1")
+        assert client["foo"] == 7
+        assert engine2.global_steps == engine.global_steps
+        for a, b in zip(jax.tree.leaves(engine.state.params),
+                        jax.tree.leaves(engine2.state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_elastic_resharding(self, tmp_path):
+        """Save under stage 2, load under stage 3 (different shardings) —
+        the reference needs checkpoint-reshape tooling for this
+        (``deepspeed/checkpoint/``); here it falls out of orbax metadata."""
+        import jax
+        cfg2 = base_config(zero_optimization={"stage": 2, "param_shard_min_size": 0})
+        _, engine = run_steps(cfg2, nsteps=2)
+        engine.save_checkpoint(str(tmp_path), tag="x")
+
+        cfg3 = base_config(zero_optimization={"stage": 3, "param_shard_min_size": 0})
+        model = SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+        params = model.init_params(jax.random.PRNGKey(1), batch_size=2)
+        engine3, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                    config=cfg3)
+        engine3.load_checkpoint(str(tmp_path), tag="x")
+        for a, b in zip(jax.tree.leaves(engine.state.params),
+                        jax.tree.leaves(engine3.state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+class TestEval:
+
+    def test_eval_mode_no_grads(self):
+        import jax
+        cfg = base_config()
+        model = SimpleModel(hidden_dim=HIDDEN)
+        params = model.init_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                   config=cfg)
+        engine.eval()
+        xs = np.random.randn(16, HIDDEN).astype(np.float32)
+        ys = np.zeros((16,), dtype=np.int32)
+        loss = engine.forward(xs, ys)
+        assert np.isfinite(float(loss))
+        assert engine._cached_grads is None
