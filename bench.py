@@ -1,0 +1,82 @@
+"""Headline benchmark: GPT-2 training throughput on the available device(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value        = model TFLOPs/chip sustained during training steps
+               (6N + attn FLOPs per token — PaLM appendix-B accounting).
+vs_baseline  = value / 64.0 — the reference's headline "64 TFLOPS/GPU
+               BERT-large on V100" (BASELINE.md; docs/_posts/
+               2020-05-28-fastest-bert-training.md:13).  Same accounting
+               style (achieved model FLOPs on one chip).
+
+Env knobs: BENCH_MODEL (gpt2|gpt2-medium|gpt2-large|gpt2-xl, default gpt2),
+BENCH_SEQ (default 512), BENCH_MICRO (default 8), BENCH_STEPS (default 20).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+
+    n_dev = jax.device_count()
+    preset = os.environ.get("BENCH_MODEL", "gpt2")
+    seq = int(os.environ.get("BENCH_SEQ", "512"))
+    micro = int(os.environ.get("BENCH_MICRO", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    cfg = gpt_config(preset, n_positions=seq, scan_layers=True,
+                     remat=False, attn_impl="auto")
+    model = GPT(cfg)
+
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+    global_batch = micro * n_dev
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, global_batch, seq)), jnp.int32)
+    batch = (ids, ids)
+
+    # warmup (compile)
+    for _ in range(2):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * global_batch / dt
+    tokens_per_sec = samples_per_sec * seq
+    tflops_per_chip = tokens_per_sec * model.flops_per_token(seq) / n_dev / 1e12
+
+    print(json.dumps({
+        "metric": f"{preset} train TFLOPs/chip (seq={seq}, micro={micro}, "
+                  f"{n_dev}x{jax.devices()[0].platform})",
+        "value": round(tflops_per_chip, 3),
+        "unit": "TFLOPs/chip",
+        "vs_baseline": round(tflops_per_chip / 64.0, 4),
+        "samples_per_sec": round(samples_per_sec, 2),
+        "loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -79,7 +79,10 @@ def init_distributed(dist_backend: str = "xla",
     num_procs = int(os.environ.get("WORLD_SIZE", os.environ.get("NUM_PROCESSES", "1")))
     if world_size > 0:
         num_procs = world_size
-    if num_procs > 1 and jax.process_count() == 1:
+    # NOTE: must not touch jax.process_count()/devices() before
+    # jax.distributed.initialize — instantiating the local backend first
+    # makes the distributed init fail.  Gate on env instead.
+    if num_procs > 1 and not jax.distributed.is_initialized():
         coord = os.environ.get("COORDINATOR_ADDRESS")
         if coord is None:
             master = os.environ.get("MASTER_ADDR", "127.0.0.1")

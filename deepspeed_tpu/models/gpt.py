@@ -1,0 +1,308 @@
+"""GPT-2 model family — the flagship training model, TPU-first.
+
+The reference has no in-tree GPT model (it wraps Megatron/HF modules); this
+framework ships one because the north-star benchmark is GPT-2-1.5B ZeRO-3
+(BASELINE.json) and the inference stack (reference
+``deepspeed/model_implementations/transformers/ds_gpt.py``) needs a concrete
+architecture to fuse.
+
+TPU-first design decisions:
+
+* ``lax.scan`` over layers (``scan_layers=True``): one compiled block body
+  regardless of depth — compile time is O(1) in ``n_layer`` and parameters
+  carry a leading ``[n_layer, ...]`` dim that the ZeRO ``fsdp`` axis shards
+  naturally.
+* Megatron-style tensor parallelism is expressed purely as sharding
+  metadata (``partition_specs``): QKV/MLP-up are column-parallel
+  (output-dim ``tensor``), attn-out/MLP-down row-parallel (input-dim
+  ``tensor``), token embedding vocab-parallel.  XLA-SPMD inserts the
+  per-layer allreduces that Megatron codes by hand.
+* Sequence parallelism: activations are sharding-constrained to
+  ``[batch, seq, embd]`` = ``(BATCH_AXES, 'seq', None)`` so a ``seq`` mesh
+  axis shards the sequence dim end-to-end; the attention op handles the
+  head/seq re-sharding (Ulysses) or ring pipelining (see
+  ``deepspeed_tpu/ops/attention.py``).
+* ``jax.checkpoint`` (remat) on the block body when ``remat=True`` — the
+  analogue of the reference's activation checkpointing
+  (``runtime/activation_checkpointing/checkpointing.py:474``).
+* bf16 activations / fp32 params by default: the engine keeps fp32 masters
+  and casts per-step (``runtime/engine.py``).
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    scan_layers: bool = True
+    remat: bool = False
+    attn_impl: str = "auto"   # 'auto' | 'flash' | 'reference' | 'ring'
+    dtype: Any = jnp.bfloat16
+    # pad vocab to a multiple (MXU-friendly, and divisible by tensor axis)
+    vocab_multiple: int = 128
+
+    def __post_init__(self):
+        self.padded_vocab = int(
+            math.ceil(self.vocab_size / self.vocab_multiple) * self.vocab_multiple)
+        assert self.n_embd % self.n_head == 0
+        self.head_dim = self.n_embd // self.n_head
+
+
+# Model zoo (GPT-2 sizes; the 1.5B "xl" is the north-star model).
+GPT_PRESETS: Dict[str, Dict] = {
+    "tiny":        dict(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=4),
+    "gpt2":        dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-medium": dict(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-large":  dict(n_embd=1280, n_layer=36, n_head=20),
+    "gpt2-xl":     dict(n_embd=1600, n_layer=48, n_head=25),
+}
+
+
+def gpt_config(preset: str = "gpt2", **overrides) -> GPTConfig:
+    kw = dict(GPT_PRESETS[preset])
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+# --------------------------------------------------------------------------- #
+# Parameter construction / partition specs
+# --------------------------------------------------------------------------- #
+def _dense_init(rng, fan_in, shape, scale=0.02):
+    return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(jnp.float32)
+
+
+def init_gpt_params(cfg: GPTConfig, rng: Array) -> Dict:
+    """Parameter pytree.  Block params are stacked ``[n_layer, ...]`` when
+    ``scan_layers`` (matching the lax.scan body)."""
+    keys = jax.random.split(rng, 8)
+    E, V, P, L = cfg.n_embd, cfg.padded_vocab, cfg.n_positions, cfg.n_layer
+    proj_scale = 0.02 / math.sqrt(2 * L)  # GPT-2 residual-proj init
+
+    def block(k):
+        ks = jax.random.split(k, 4)
+        return {
+            "ln1_g": jnp.ones((E,), jnp.float32),
+            "ln1_b": jnp.zeros((E,), jnp.float32),
+            "qkv_w": _dense_init(ks[0], E, (E, 3 * E)),
+            "qkv_b": jnp.zeros((3 * E,), jnp.float32),
+            "out_w": _dense_init(ks[1], E, (E, E), scale=proj_scale),
+            "out_b": jnp.zeros((E,), jnp.float32),
+            "ln2_g": jnp.ones((E,), jnp.float32),
+            "ln2_b": jnp.zeros((E,), jnp.float32),
+            "fc_w": _dense_init(ks[2], E, (E, 4 * E)),
+            "fc_b": jnp.zeros((4 * E,), jnp.float32),
+            "proj_w": _dense_init(ks[3], 4 * E, (4 * E, E), scale=proj_scale),
+            "proj_b": jnp.zeros((E,), jnp.float32),
+        }
+
+    if cfg.scan_layers:
+        blocks = jax.vmap(block)(jax.random.split(keys[2], L))
+    else:
+        blocks = {f"h{i}": block(k) for i, k in enumerate(jax.random.split(keys[2], L))}
+    return {
+        "wte": _dense_init(keys[0], V, (V, E)),
+        "wpe": _dense_init(keys[1], P, (P, E), scale=0.01),
+        "blocks": blocks,
+        "lnf_g": jnp.ones((E,), jnp.float32),
+        "lnf_b": jnp.zeros((E,), jnp.float32),
+    }
+
+
+_BLOCK_SPECS = {
+    # Megatron TP: column-parallel QKV/fc (shard output dim), row-parallel
+    # out/proj (shard input dim); biases of column-parallel layers sharded.
+    "ln1_g": PartitionSpec(), "ln1_b": PartitionSpec(),
+    "qkv_w": PartitionSpec(None, "tensor"), "qkv_b": PartitionSpec("tensor"),
+    "out_w": PartitionSpec("tensor", None), "out_b": PartitionSpec(),
+    "ln2_g": PartitionSpec(), "ln2_b": PartitionSpec(),
+    "fc_w": PartitionSpec(None, "tensor"), "fc_b": PartitionSpec("tensor"),
+    "proj_w": PartitionSpec("tensor", None), "proj_b": PartitionSpec(),
+}
+
+
+def gpt_partition_specs(cfg: GPTConfig) -> Dict:
+    """Logical (tensor-parallel) PartitionSpecs matching ``init_gpt_params``.
+
+    The ZeRO policy composes the ``fsdp`` axis on top of these
+    (``runtime/zero/policy.py:zero_partition_spec``) — stage-3 + TP gives
+    2-D sharded weights, the TPU analogue of Megatron+ZeRO.
+    """
+    def block_specs(stacked: bool):
+        pre = (None,) if stacked else ()
+        return {k: PartitionSpec(*pre, *s) for k, s in _BLOCK_SPECS.items()}
+
+    if cfg.scan_layers:
+        blocks = block_specs(True)
+    else:
+        blocks = {f"h{i}": block_specs(False) for i in range(cfg.n_layer)}
+    return {
+        "wte": PartitionSpec("tensor", None),   # vocab-parallel embedding
+        "wpe": PartitionSpec(),
+        "blocks": blocks,
+        "lnf_g": PartitionSpec(),
+        "lnf_b": PartitionSpec(),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Forward
+# --------------------------------------------------------------------------- #
+def _constrain(x: Array, *spec) -> Array:
+    """Activation sharding constraint (no-op without a mesh)."""
+    if mesh_lib.has_mesh():
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh_lib.get_mesh(), PartitionSpec(*spec)))
+    return x
+
+
+def layer_norm(x: Array, g: Array, b: Array, eps: float = 1e-5) -> Array:
+    # fp32 statistics regardless of activation dtype (bf16-safe)
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g + b).astype(x.dtype)
+
+
+def _dropout(x: Array, rate: float, rng: Optional[Array], train: bool) -> Array:
+    if not train or rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
+              train: bool, attention_fn: Callable) -> Array:
+    """One transformer block on ``x: [batch, seq, embd]``."""
+    B, S, E = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    dt = x.dtype
+    r = (jax.random.split(rng, 3) if rng is not None else (None, None, None))
+
+    h = layer_norm(x, p["ln1_g"], p["ln1_b"])
+    qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, D)
+    k = k.reshape(B, S, H, D)
+    v = v.reshape(B, S, H, D)
+    # heads sharded over tensor axis (Megatron attention parallelism)
+    q = _constrain(q, mesh_lib.BATCH_AXES, "seq", "tensor", None)
+    k = _constrain(k, mesh_lib.BATCH_AXES, "seq", "tensor", None)
+    v = _constrain(v, mesh_lib.BATCH_AXES, "seq", "tensor", None)
+    o = attention_fn(q, k, v, causal=True)
+    o = o.reshape(B, S, E)
+    o = o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+    x = x + _dropout(o, cfg.dropout, r[0], train)
+    x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+
+    h = layer_norm(x, p["ln2_g"], p["ln2_b"])
+    h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+    x = x + _dropout(h, cfg.dropout, r[1], train)
+    return _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+
+
+def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
+                rng: Optional[Array] = None, train: bool = False,
+                attention_fn: Optional[Callable] = None) -> Array:
+    """Logits ``[batch, seq, padded_vocab]`` (bf16 compute, fp32 logits)."""
+    from deepspeed_tpu.ops.attention import get_attention_fn
+    attention_fn = attention_fn or get_attention_fn(cfg.attn_impl)
+
+    B, S = input_ids.shape
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[input_ids] + params["wpe"].astype(dt)[:S][None]
+    x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+    x = _dropout(x, cfg.dropout, rng, train)
+
+    body = partial(gpt_block, cfg, train=train, attention_fn=attention_fn)
+    if cfg.remat:
+        body = jax.checkpoint(body, static_argnums=())
+
+    if cfg.scan_layers:
+        rngs = (jax.random.split(jax.random.fold_in(rng, 7), cfg.n_layer)
+                if (rng is not None and train) else None)
+
+        def scan_body(x, layer):
+            p, r = layer
+            return body(p, x, r), None
+
+        xs = (params["blocks"], rngs) if rngs is not None else (
+            params["blocks"], jnp.zeros((cfg.n_layer, 2), jnp.uint32))
+        if rngs is None:
+            def scan_body(x, layer):  # noqa: F811 — no-dropout variant
+                p, _ = layer
+                return body(p, x, None), None
+        x, _ = jax.lax.scan(scan_body, x, xs)
+    else:
+        for i in range(cfg.n_layer):
+            r = jax.random.fold_in(rng, i) if (rng is not None and train) else None
+            x = body(params["blocks"][f"h{i}"], x, r)
+
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+    # tied embedding projection; vocab-parallel → logits sharded over tensor
+    logits = (x @ params["wte"].astype(dt).T).astype(jnp.float32)
+    return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
+
+
+def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
+             rng: Optional[Array] = None, train: bool = True,
+             attention_fn: Optional[Callable] = None) -> Array:
+    """Next-token cross-entropy, masking padded vocab entries."""
+    logits = gpt_forward(cfg, params, input_ids, rng, train, attention_fn)
+    if cfg.padded_vocab != cfg.vocab_size:
+        mask = jnp.arange(cfg.padded_vocab) < cfg.vocab_size
+        logits = jnp.where(mask[None, None, :], logits, -1e9)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+class GPT:
+    """Engine-compatible model object (``.apply``-free callable convention:
+    ``fn(params, batch, rng, train) -> loss``) with ``init_params``."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def __call__(self, params, batch, rng, train):
+        input_ids, labels = batch
+        return gpt_loss(self.cfg, params, input_ids, labels, rng, train)
+
+    def init_params(self, rng):
+        return init_gpt_params(self.cfg, rng)
+
+    def partition_specs(self):
+        return gpt_partition_specs(self.cfg)
+
+    def num_params(self) -> int:
+        cfg = self.cfg
+        E, L = cfg.n_embd, cfg.n_layer
+        per_block = 12 * E * E + 13 * E
+        return cfg.padded_vocab * E + cfg.n_positions * E + L * per_block + 2 * E
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token ≈ 6N + attention term (PaLM appendix B)."""
+        cfg = self.cfg
+        n = self.num_params()
+        attn = 12 * cfg.n_layer * cfg.n_embd * seq_len
+        return 6 * n + attn

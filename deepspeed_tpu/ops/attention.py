@@ -1,0 +1,77 @@
+"""Attention ops — registry + reference implementation.
+
+The reference's attention fast paths are CUDA kernels
+(``csrc/transformer/softmax_kernels.cu``, inference ``softmax_context`` in
+``csrc/transformer/inference/csrc/pt_binding.cpp:1717-1781``).  Here the
+fast path is a Pallas TPU flash-attention kernel
+(``deepspeed_tpu/ops/pallas/flash_attention.py``) and the reference path is
+pure jnp (XLA still fuses it into a handful of kernels); parity tests compare
+the two the way ``tests/unit/ops/accelerators/test_accelerator_forward.py``
+compares fused CUDA vs HF modeling.
+
+All implementations share one signature::
+
+    fn(q, k, v, *, causal: bool) -> out     # [batch, seq, heads, head_dim]
+"""
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+import numpy as np
+
+
+def reference_attention(q, k, v, *, causal: bool = True):
+    """Pure-jnp multi-head attention, fp32 softmax accumulation."""
+    B, S, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention(q, k, v, *, causal: bool = True):
+    """Pallas flash attention on TPU; falls back to the reference path on
+    other backends (tests run on the CPU mesh)."""
+    if not _on_tpu():
+        return reference_attention(q, k, v, causal=causal)
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention as fa
+    return fa(q, k, v, causal=causal)
+
+
+def ring_attention(q, k, v, *, causal: bool = True):
+    """Ring attention over the ``seq`` mesh axis (KV blocks rotated by
+    ppermute).  Must run inside shard_map; see
+    ``deepspeed_tpu/parallel/sequence.py``."""
+    from deepspeed_tpu.parallel.sequence import ring_attention as ra
+    return ra(q, k, v, causal=causal)
+
+
+_REGISTRY = {
+    "reference": reference_attention,
+    "flash": flash_attention,
+    "ring": ring_attention,
+}
+
+
+def get_attention_fn(impl: str = "auto") -> Callable:
+    if impl == "auto":
+        impl = "flash"
+    assert impl in _REGISTRY, f"unknown attention impl {impl!r}; have {list(_REGISTRY)}"
+    return _REGISTRY[impl]
+
+
+def register_attention(name: str, fn: Callable):
+    _REGISTRY[name] = fn

@@ -1,0 +1,235 @@
+"""Pallas TPU flash attention (training fast path, forward + backward).
+
+TPU-native replacement for the reference's fused attention CUDA kernels
+(``csrc/transformer/softmax_kernels.cu`` and the strided-batch-gemm pipeline
+of ``csrc/transformer/ds_transformer_cuda.cpp``).  Online-softmax tiling:
+O(S) memory, MXU-shaped [128, head_dim] tiles, fp32 accumulation, bf16
+operands.
+
+Layout convention here is [batch, heads, seq, head_dim]; the public wrapper
+(`flash_attention`) takes the framework-wide [batch, seq, heads, head_dim].
+
+``interpret=True`` (automatic off-TPU) runs the same kernels through the
+Pallas interpreter so CPU CI validates them against the jnp reference — the
+analogue of the reference's kernel-vs-HF-modeling parity tests
+(``tests/unit/ops/accelerators/test_accelerator_forward.py``).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
+def _block_sizes(S: int, bq: Optional[int], bk: Optional[int]):
+    bq = bq or min(128, S)
+    bk = bk or min(128, S)
+    assert S % bq == 0 and S % bk == 0, f"seq {S} not divisible by blocks {bq}/{bk}"
+    return bq, bk
+
+
+# --------------------------------------------------------------------------- #
+# Forward
+# --------------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, S):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+    D = q.shape[-1]
+
+    if causal:
+        num_kb = pl.cdiv((qi + 1) * bq, bk)
+    else:
+        num_kb = S // bk
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [bk, D]
+        v = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                                preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, a0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, *, causal, scale, bq=None, bk=None):
+    B, H, S, D = q.shape
+    bq, bk = _block_sizes(S, bq, bk)
+    grid = (B, H, S // bq)
+    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, S=S),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            kv_spec, kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------- #
+# Backward
+# --------------------------------------------------------------------------- #
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, bq, bk, S):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]          # [bq, 1]
+    delta = delta_ref[0, 0][:, None]      # [bq, 1]
+    D = q.shape[-1]
+
+    num_kb = pl.cdiv((qi + 1) * bq, bk) if causal else S // bk
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, bq, bk, S):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)   # [bk, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    D = k.shape[-1]
+    num_qb = S // bq
+    start_qb = (ki * bk) // bq if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                                    # [bq, bk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                           # [bq, bk]
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (z, z))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, bq, bk, res, do):
+    q, k, v, o, lse = res
+    B, H, S, D = q.shape
+    bq_, bk_ = _block_sizes(S, bq, bk)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # [B,H,S]
+
+    qspec = pl.BlockSpec((1, 1, bq_, D), lambda b, h, i: (b, h, i, 0))
+    full = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0))
+    vec_q = pl.BlockSpec((1, 1, bq_), lambda b, h, i: (b, h, i))
+    vec_full = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq_, bk=bk_, S=S),
+        grid=(B, H, S // bq_),
+        in_specs=[qspec, full, full, qspec, vec_q, vec_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    kspec = pl.BlockSpec((1, 1, bk_, D), lambda b, h, j: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq_, bk=bk_, S=S),
+        grid=(B, H, S // bk_),
+        in_specs=[full, kspec, kspec, full, vec_full, vec_full],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, S, D), v.dtype)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, bq, bk):
+    o, _ = _fwd(q, k, v, causal=causal, scale=scale, bq=bq, bk=bk)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, bq, bk):
+    o, lse = _fwd(q, k, v, causal=causal, scale=scale, bq=bq, bk=bk)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    block_q: Optional[int] = None, block_k: Optional[int] = None):
+    """[batch, seq, heads, head_dim] flash attention (differentiable)."""
+    B, S, H, D = q.shape
+    if S % min(128, S) != 0:
+        from deepspeed_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal)
+    scale = 1.0 / np.sqrt(D)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o = _flash(qt, kt, vt, causal, scale, block_q, block_k)
+    return o.transpose(0, 2, 1, 3)

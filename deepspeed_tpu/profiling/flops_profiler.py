@@ -51,7 +51,6 @@ class FlopsProfiler:
         self._t0 = time.time()
         if self.engine is not None and self.flops_per_step is None and batch is not None:
             try:
-                fn = self.engine._grad_step or self.engine._build_grad_step()
                 cost = analyze_fn_cost(
                     lambda p, b: self.engine._value_and_grad(p, b, jax.random.PRNGKey(0), 1.0),
                     self.engine.state.params, batch)

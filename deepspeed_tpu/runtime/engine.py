@@ -223,6 +223,13 @@ class DeepSpeedEngine:
           of the reference's SimpleModel returning loss in tests);
         * a plain callable ``fn(params, batch, rng, train)``.
         """
+        def unpack(batch):
+            """forward(*args, **kwargs) packs kwargs into the batch pytree
+            (so they are traced, not silently dropped)."""
+            if isinstance(batch, dict) and "__kwargs__" in batch:
+                return batch["__args__"], dict(batch["__kwargs__"])
+            return (batch if isinstance(batch, (tuple, list)) else (batch,)), {}
+
         if hasattr(model, "apply"):
             import inspect
             try:
@@ -232,14 +239,23 @@ class DeepSpeedEngine:
 
             def fn(params, batch, rng, train):
                 variables = {"params": params}
-                args = batch if isinstance(batch, (tuple, list)) else (batch,)
-                kwargs = {"train": train} if takes_train else {}
+                args, kw = unpack(batch)
+                if takes_train:
+                    kw["train"] = train
                 rngs = {"dropout": rng, "ltd": jax.random.fold_in(rng, 1)} if train else {}
-                return model.apply(variables, *args, rngs=rngs, **kwargs)
+                return model.apply(variables, *args, rngs=rngs, **kw)
 
             return fn
         assert callable(model), f"model must be callable or flax-like, got {type(model)}"
-        return model
+
+        def fn(params, batch, rng, train):
+            if isinstance(batch, dict) and "__kwargs__" in batch:
+                args, kw = batch["__args__"], batch["__kwargs__"]
+                batch = args if len(args) != 1 else args[0]
+                return model(params, batch, rng, train, **kw)
+            return model(params, batch, rng, train)
+
+        return fn
 
     def _init_parameters(self, model, model_parameters):
         if model_parameters is None and hasattr(model, "init_params"):
@@ -249,9 +265,13 @@ class DeepSpeedEngine:
             "with .init_params(rng)")
         # fp32 master copy, placed per ZeRO policy (stage 3 shards, else replicated)
         params32 = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
-        self.param_shardings = self.zero_policy.param_shardings(params32)
+        # Tensor-parallel (logical) specs from the model, composed under fsdp
+        # (the TPU analogue of Megatron TP + ZeRO stacking).
+        self._logical_specs = (model.partition_specs()
+                               if hasattr(model, "partition_specs") else None)
+        self.param_shardings = self.zero_policy.param_shardings(params32, self._logical_specs)
         self.state.params = jax.device_put(params32, self.param_shardings)
-        self.grad_shardings = self.zero_policy.grad_shardings(params32)
+        self.grad_shardings = self.zero_policy.grad_shardings(params32, self._logical_specs)
         nparams = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params32))
         self._num_params = nparams
         log_dist(f"model parameters: {nparams:,}", ranks=[0])
@@ -289,7 +309,8 @@ class DeepSpeedEngine:
                                lr_schedule=self._schedule_fn)
         self.tx = tx
         opt_shapes = jax.eval_shape(tx.init, self.state.params)
-        self.opt_shardings = self.zero_policy.opt_shardings(opt_shapes, self.state.params)
+        self.opt_shardings = self.zero_policy.opt_shardings(opt_shapes, self.state.params,
+                                                           getattr(self, "_logical_specs", None))
         self.opt_shardings = self._maybe_offload(self.opt_shardings)
         self.state.opt_state = jax.jit(tx.init, out_shardings=self.opt_shardings)(self.state.params)
 
@@ -464,7 +485,14 @@ class DeepSpeedEngine:
         no way, nor any reason, to run it separately); ``backward`` then
         accumulates them.
         """
-        batch = inputs if len(inputs) != 1 else inputs[0]
+        if self.progressive_layer_drop is not None:
+            # reference engine.py:1685-1686: PLD state is fed to the model
+            kwargs.update(self.progressive_layer_drop.get_state())
+            kwargs["pld_theta"] = jnp.float32(kwargs["pld_theta"])
+        if kwargs:
+            batch = {"__args__": tuple(inputs), "__kwargs__": kwargs}
+        else:
+            batch = inputs if len(inputs) != 1 else inputs[0]
         batch = self._place_batch(batch)
         if self.flops_profiler:
             self.flops_profiler.start_profile(batch)
@@ -540,6 +568,14 @@ class DeepSpeedEngine:
             self.global_steps += 1
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
+            if self.flops_profiler is not None:
+                self.flops_profiler.stop_profile()
+                fc = self._config.flops_profiler_config
+                if self.global_steps == fc.profile_step:
+                    self.flops_profiler.print_model_profile(
+                        profile_step=fc.profile_step, output_file=fc.output_file)
             self._report_progress()
 
     def train_batch(self, data_iter=None, batch=None):

@@ -31,21 +31,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 Pytree = Any
 
 
-def choose_shard_dim(shape, axis_size: int, preferred: Optional[int] = None) -> Optional[int]:
-    """Pick the dimension to shard over ``fsdp``: the largest one divisible
-    by ``axis_size`` (ties → earliest)."""
-    if axis_size <= 1:
-        return None
-    best, best_size = None, 0
-    dims = range(len(shape)) if preferred is None else [preferred] + [d for d in range(len(shape)) if d != preferred]
-    for d in dims:
-        if shape[d] % axis_size == 0 and shape[d] > best_size:
-            best, best_size = d, shape[d]
-            if preferred is not None and d == preferred:
-                break
-    return best
-
-
 def zero_partition_spec(shape, fsdp_size: int, min_size: int = 2**12,
                         existing: Optional[PartitionSpec] = None) -> PartitionSpec:
     """PartitionSpec sharding one dim over 'fsdp', composed with an existing
@@ -125,22 +110,47 @@ class ZeroShardingPolicy:
         if self.stage < 1:
             return jax.tree.map(lambda l: NamedSharding(self.mesh, PartitionSpec()), opt_state_shapes)
 
-        # Build shape -> spec lookup from params (logical spec composed).
+        # Match each optimizer-state leaf to its parameter by TREE-PATH
+        # SUFFIX: optax mirrors the param tree inside each state field
+        # (mu/nu/trace/...), so the state leaf's path ends with the param's
+        # path (e.g. ('0','mu','blocks','qkv_w') ends with
+        # ('blocks','qkv_w')).  Shape-only matching would collide for
+        # same-shaped params with different tensor-parallel specs.
         lspecs = logical_specs if logical_specs is not None else jax.tree.map(lambda _: None, params)
-        shape_to_spec = {}
-        for leaf, lspec in zip(jax.tree.leaves(params), jax.tree.leaves(lspecs, is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))):
-            shape = tuple(leaf.shape)
-            if shape not in shape_to_spec:
-                shape_to_spec[shape] = _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec)
+        is_spec_leaf = lambda x: x is None or isinstance(x, PartitionSpec)
 
-        def make(leaf):
-            shape = tuple(getattr(leaf, "shape", ()))
-            spec = shape_to_spec.get(shape)
-            if spec is None:
-                spec = zero_partition_spec(shape, self.fsdp_size, self.min_size)
-            return NamedSharding(self.mesh, spec)
+        def path_keys(path):
+            out = []
+            for p in path:
+                k = getattr(p, "key", getattr(p, "name", getattr(p, "idx", None)))
+                out.append(str(k))
+            return tuple(out)
 
-        return jax.tree.map(make, opt_state_shapes)
+        param_paths = [(path_keys(path), tuple(leaf.shape),
+                        _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec))
+                       for (path, leaf), lspec in zip(
+                           jax.tree_util.tree_flatten_with_path(params)[0],
+                           jax.tree.leaves(lspecs, is_leaf=is_spec_leaf))]
+
+        def lookup(path, shape):
+            keys = path_keys(path)
+            best = None
+            for pkeys, pshape, spec in param_paths:
+                if pshape != shape:
+                    continue
+                n = len(pkeys)
+                if n <= len(keys) and keys[-n:] == pkeys:
+                    if best is None or n > best[0]:
+                        best = (n, spec)
+            if best is not None:
+                return best[1]
+            # no path match (e.g. flattened/custom state): derive from shape
+            return zero_partition_spec(shape, self.fsdp_size, self.min_size)
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state_shapes)
+        shardings = [NamedSharding(self.mesh, lookup(path, tuple(getattr(leaf, "shape", ()))))
+                     for path, leaf in flat]
+        return jax.tree_util.tree_unflatten(jax.tree.structure(opt_state_shapes), shardings)
 
     # ------------------------------------------------------------------ #
     def describe(self) -> str:

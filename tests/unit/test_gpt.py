@@ -1,0 +1,76 @@
+"""GPT model family tests: forward shapes, loss sanity, TP/ZeRO-3 sharded
+training on the 8-device CPU mesh, scan vs unrolled equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt import (GPT, gpt_config, gpt_forward, gpt_loss,
+                                      init_gpt_params)
+from deepspeed_tpu.parallel.mesh import MeshSpec
+
+
+def tiny_cfg(**kw):
+    base = dict(attn_impl="reference")
+    base.update(kw)
+    return gpt_config("tiny", **base)
+
+
+def test_forward_shape_and_loss():
+    cfg = tiny_cfg()
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.zeros((2, 32), jnp.int32)
+    logits = gpt_forward(cfg, params, ids)
+    assert logits.shape == (2, 32, cfg.padded_vocab)
+    loss = gpt_loss(cfg, params, ids, ids, train=False)
+    # near-uniform at init → loss ≈ ln(vocab)
+    assert 0.5 * np.log(cfg.vocab_size) < float(loss) < 2.0 * np.log(cfg.vocab_size)
+
+
+def test_scan_matches_unrolled():
+    cfg_s = tiny_cfg(scan_layers=True, dtype=jnp.float32)
+    cfg_u = tiny_cfg(scan_layers=False, dtype=jnp.float32)
+    ps = init_gpt_params(cfg_s, jax.random.PRNGKey(1))
+    # restack scanned params into the unrolled layout
+    pu = dict(ps)
+    pu["blocks"] = {f"h{i}": jax.tree.map(lambda x: x[i], ps["blocks"])
+                    for i in range(cfg_s.n_layer)}
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, cfg_s.vocab_size)
+    a = gpt_forward(cfg_s, ps, ids)
+    b = gpt_forward(cfg_u, pu, ids)
+    np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32),
+                               atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_gpt_trains_with_tp_and_zero(stage):
+    """TP=2 × fsdp=2 × data=2 mesh; loss must go down on a memorization task."""
+    spec = MeshSpec(data=2, fsdp=2, tensor=2, device_count=8)
+    mesh = spec.build(jax.devices()[:8])
+    cfg = tiny_cfg(n_embd=64, n_head=2, n_layer=2, vocab_size=256)
+    model = GPT(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "bf16": {"enabled": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config, mesh=mesh)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8, 32), 0, cfg.vocab_size)
+    losses = [float(engine.train_batch(batch=(ids, ids))) for _ in range(8)]
+    assert losses[-1] < losses[0] * 0.8, f"no learning: {losses}"
+
+
+def test_remat_matches():
+    cfg_a = tiny_cfg(remat=False)
+    cfg_b = tiny_cfg(remat=True)
+    p = init_gpt_params(cfg_a, jax.random.PRNGKey(3))
+    ids = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, cfg_a.vocab_size)
+
+    ga = jax.grad(lambda p: gpt_loss(cfg_a, p, ids, ids, train=False))(p)
+    gb = jax.grad(lambda p: gpt_loss(cfg_b, p, ids, ids, train=False))(p)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
