@@ -31,7 +31,6 @@ def main():
     seq = int(os.environ.get("BENCH_SEQ", "512"))
     micro = int(os.environ.get("BENCH_MICRO", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    on_tpu = jax.devices()[0].platform == "tpu"
 
     cfg = gpt_config(preset, n_positions=seq, scan_layers=True,
                      remat=False, attn_impl="auto")

@@ -89,37 +89,50 @@ def _dense_init(rng, fan_in, shape, scale=0.02):
     return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(jnp.float32)
 
 
+def _init_block(cfg: GPTConfig, rng: Array) -> Dict:
+    """One transformer block's params (GPT-2 init: residual projections
+    scaled by 1/sqrt(2L))."""
+    E = cfg.n_embd
+    proj_scale = 0.02 / math.sqrt(2 * cfg.n_layer)
+    ks = jax.random.split(rng, 4)
+    return {
+        "ln1_g": jnp.ones((E,), jnp.float32),
+        "ln1_b": jnp.zeros((E,), jnp.float32),
+        "qkv_w": _dense_init(ks[0], E, (E, 3 * E)),
+        "qkv_b": jnp.zeros((3 * E,), jnp.float32),
+        "out_w": _dense_init(ks[1], E, (E, E), scale=proj_scale),
+        "out_b": jnp.zeros((E,), jnp.float32),
+        "ln2_g": jnp.ones((E,), jnp.float32),
+        "ln2_b": jnp.zeros((E,), jnp.float32),
+        "fc_w": _dense_init(ks[2], E, (E, 4 * E)),
+        "fc_b": jnp.zeros((4 * E,), jnp.float32),
+        "proj_w": _dense_init(ks[3], 4 * E, (4 * E, E), scale=proj_scale),
+        "proj_b": jnp.zeros((E,), jnp.float32),
+    }
+
+
+def _init_embed(cfg: GPTConfig, rng: Array) -> Dict:
+    ks = jax.random.split(rng, 2)
+    return {"wte": _dense_init(ks[0], cfg.padded_vocab, (cfg.padded_vocab, cfg.n_embd)),
+            "wpe": _dense_init(ks[1], cfg.n_positions, (cfg.n_positions, cfg.n_embd),
+                               scale=0.01)}
+
+
 def init_gpt_params(cfg: GPTConfig, rng: Array) -> Dict:
     """Parameter pytree.  Block params are stacked ``[n_layer, ...]`` when
     ``scan_layers`` (matching the lax.scan body)."""
     keys = jax.random.split(rng, 8)
-    E, V, P, L = cfg.n_embd, cfg.padded_vocab, cfg.n_positions, cfg.n_layer
-    proj_scale = 0.02 / math.sqrt(2 * L)  # GPT-2 residual-proj init
-
-    def block(k):
-        ks = jax.random.split(k, 4)
-        return {
-            "ln1_g": jnp.ones((E,), jnp.float32),
-            "ln1_b": jnp.zeros((E,), jnp.float32),
-            "qkv_w": _dense_init(ks[0], E, (E, 3 * E)),
-            "qkv_b": jnp.zeros((3 * E,), jnp.float32),
-            "out_w": _dense_init(ks[1], E, (E, E), scale=proj_scale),
-            "out_b": jnp.zeros((E,), jnp.float32),
-            "ln2_g": jnp.ones((E,), jnp.float32),
-            "ln2_b": jnp.zeros((E,), jnp.float32),
-            "fc_w": _dense_init(ks[2], E, (E, 4 * E)),
-            "fc_b": jnp.zeros((4 * E,), jnp.float32),
-            "proj_w": _dense_init(ks[3], 4 * E, (4 * E, E), scale=proj_scale),
-            "proj_b": jnp.zeros((E,), jnp.float32),
-        }
+    E, L = cfg.n_embd, cfg.n_layer
 
     if cfg.scan_layers:
-        blocks = jax.vmap(block)(jax.random.split(keys[2], L))
+        blocks = jax.vmap(partial(_init_block, cfg))(jax.random.split(keys[2], L))
     else:
-        blocks = {f"h{i}": block(k) for i, k in enumerate(jax.random.split(keys[2], L))}
+        blocks = {f"h{i}": _init_block(cfg, k)
+                  for i, k in enumerate(jax.random.split(keys[2], L))}
+    embed = _init_embed(cfg, jax.random.fold_in(keys[0], 0))
     return {
-        "wte": _dense_init(keys[0], V, (V, E)),
-        "wpe": _dense_init(keys[1], P, (P, E), scale=0.01),
+        "wte": embed["wte"],
+        "wpe": embed["wpe"],
         "blocks": blocks,
         "lnf_g": jnp.ones((E,), jnp.float32),
         "lnf_b": jnp.zeros((E,), jnp.float32),
@@ -167,10 +180,10 @@ def gpt_partition_specs(cfg: GPTConfig) -> Dict:
 # --------------------------------------------------------------------------- #
 def _constrain(x: Array, *spec) -> Array:
     """Activation sharding constraint (no-op without a mesh)."""
-    if mesh_lib.has_mesh():
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh_lib.get_mesh(), PartitionSpec(*spec)))
-    return x
+    if not mesh_lib.has_mesh():
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh_lib.get_mesh(), PartitionSpec(*spec)))
 
 
 def layer_norm(x: Array, g: Array, b: Array, eps: float = 1e-5) -> Array:
@@ -269,12 +282,120 @@ def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
              attention_fn: Optional[Callable] = None) -> Array:
     """Next-token cross-entropy, masking padded vocab entries."""
     logits = gpt_forward(cfg, params, input_ids, rng, train, attention_fn)
-    if cfg.padded_vocab != cfg.vocab_size:
-        mask = jnp.arange(cfg.padded_vocab) < cfg.vocab_size
-        logits = jnp.where(mask[None, None, :], logits, -1e9)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return gpt_ce_loss_fn(cfg)(logits, labels)
+
+
+# --------------------------------------------------------------------------- #
+# Pipeline-parallel layer classes (for PipelineModule / PipelineEngine)
+# --------------------------------------------------------------------------- #
+class GPTEmbedLayer:
+    """Token+position embedding as pipeline stage-0 layer."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng):
+        return _init_embed(self.cfg, rng)
+
+    def partition_specs(self):
+        return {"wte": PartitionSpec("tensor", None), "wpe": PartitionSpec()}
+
+    def __call__(self, p, ids, rng=None, train=False):
+        dt = self.cfg.dtype
+        S = ids.shape[-1]
+        x = p["wte"].astype(dt)[ids] + p["wpe"].astype(dt)[:S][None]
+        x = _dropout(x, self.cfg.dropout, rng, train)
+        return _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+
+
+class GPTBlockLayer:
+    """One transformer block as a homogeneous pipeline middle layer."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng):
+        return _init_block(self.cfg, rng)
+
+    def partition_specs(self):
+        return dict(_BLOCK_SPECS)
+
+    def __call__(self, p, x, rng=None, train=False):
+        from deepspeed_tpu.ops.attention import get_attention_fn
+        return gpt_block(self.cfg, p, x, rng=rng, train=train,
+                         attention_fn=get_attention_fn(self.cfg.attn_impl))
+
+
+class GPTHeadLayer:
+    """Final LN + (untied) unembedding projection."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng):
+        cfg = self.cfg
+        return {"lnf_g": jnp.ones((cfg.n_embd,), jnp.float32),
+                "lnf_b": jnp.zeros((cfg.n_embd,), jnp.float32),
+                "unembed": _dense_init(rng, cfg.n_embd, (cfg.n_embd, cfg.padded_vocab))}
+
+    def partition_specs(self):
+        return {"lnf_g": PartitionSpec(), "lnf_b": PartitionSpec(),
+                "unembed": PartitionSpec(None, "tensor")}
+
+    def __call__(self, p, x, rng=None, train=False):
+        x = layer_norm(x, p["lnf_g"], p["lnf_b"])
+        logits = (x @ p["unembed"].astype(x.dtype)).astype(jnp.float32)
+        return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
+
+
+def gpt_ce_loss_fn(cfg: GPTConfig):
+    def loss_fn(logits, labels):
+        if cfg.padded_vocab != cfg.vocab_size:
+            mask = jnp.arange(cfg.padded_vocab) < cfg.vocab_size
+            logits = jnp.where(mask[None, None, :], logits, -1e9)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+    return loss_fn
+
+
+class GPTTiedHeadLayer:
+    """Final LN + unembedding through the TIED token embedding: the tied
+    params arrive as the embed layer's pytree (reference ``TiedLayerSpec``
+    reuse-site ``forward_fn``, ``pipe/module.py:76``)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng):
+        return {"lnf_g": jnp.ones((self.cfg.n_embd,), jnp.float32),
+                "lnf_b": jnp.zeros((self.cfg.n_embd,), jnp.float32)}
+
+    def partition_specs(self):
+        return {"lnf_g": PartitionSpec(), "lnf_b": PartitionSpec()}
+
+    def __call__(self, p, x, tied=None, rng=None, train=False):
+        x = layer_norm(x, p["lnf_g"], p["lnf_b"])
+        logits = (x @ tied["wte"].astype(x.dtype).T).astype(jnp.float32)
+        return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
+
+
+def gpt_pipeline_module(cfg: GPTConfig, num_stages: int, tied_embedding: bool = False):
+    """Layer-list GPT for the PipelineEngine (the analogue of building a
+    Megatron GPT from ``LayerSpec``s, reference ``pipe/module.py:85``).
+    ``tied_embedding=True`` shares wte between embed and head via
+    ``TiedLayerSpec`` (reference embedding/unembedding tying)."""
+    from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                                   TiedLayerSpec)
+    blocks = [LayerSpec(GPTBlockLayer, cfg) for _ in range(cfg.n_layer)]
+    if tied_embedding:
+        specs = ([TiedLayerSpec("embed", GPTEmbedLayer, cfg)] + blocks
+                 + [TiedLayerSpec("embed", GPTTiedHeadLayer, cfg)])
+    else:
+        specs = ([LayerSpec(GPTEmbedLayer, cfg)] + blocks
+                 + [LayerSpec(GPTHeadLayer, cfg)])
+    return PipelineModule(layers=specs, num_stages=num_stages,
+                          loss_fn=gpt_ce_loss_fn(cfg))
 
 
 class GPT:

@@ -53,16 +53,23 @@ def flash_attention(q, k, v, *, causal: bool = True):
 
 def ring_attention(q, k, v, *, causal: bool = True):
     """Ring attention over the ``seq`` mesh axis (KV blocks rotated by
-    ppermute).  Must run inside shard_map; see
-    ``deepspeed_tpu/parallel/sequence.py``."""
+    ppermute); see ``deepspeed_tpu/parallel/sequence.py``."""
     from deepspeed_tpu.parallel.sequence import ring_attention as ra
     return ra(q, k, v, causal=causal)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = True):
+    """Ulysses-style all-to-all sequence parallel attention; see
+    ``deepspeed_tpu/parallel/sequence.py``."""
+    from deepspeed_tpu.parallel.sequence import ulysses_attention as ua
+    return ua(q, k, v, causal=causal, inner=flash_attention)
 
 
 _REGISTRY = {
     "reference": reference_attention,
     "flash": flash_attention,
     "ring": ring_attention,
+    "ulysses": ulysses_attention,
 }
 
 

@@ -1,0 +1,111 @@
+"""Sequence/context parallelism — first-class here, absent in the reference.
+
+The reference snapshot (v0.8.3) predates DeepSpeed-Ulysses and has no
+SP/CP implementation (SURVEY.md §5.7); its long-sequence answer was
+block-sparse attention.  This module fills the gap with the two standard
+TPU-native schemes over the ``seq`` mesh axis:
+
+* **Ulysses-style all-to-all** (`ulysses_attention`): activations arrive
+  sequence-sharded ``[B, S/sp, H, D]``; re-shard to head-sharded
+  ``[B, S, H/sp, D]`` for exact attention, then back.  Expressed purely as
+  sharding constraints — XLA inserts the two all-to-alls (this is the
+  idiomatic SPMD formulation; DeepSpeed-Ulysses codes the a2a by hand).
+
+* **Ring attention** (`ring_attention`): KV blocks rotate around the
+  ``seq`` ICI ring via ``ppermute`` while each device keeps its Q shard;
+  online-softmax merging keeps O(S/sp) memory per device and never
+  materializes the full sequence anywhere.  shard_map manual over ``seq``.
+
+Both keep the framework-wide attention signature
+``fn(q, k, v, *, causal) -> out`` with ``[batch, seq, heads, head_dim]``.
+"""
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+NEG_INF = -1e30
+
+
+def _constrain(x, *spec):
+    if mesh_lib.has_mesh():
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh_lib.get_mesh(), PartitionSpec(*spec)))
+    return x
+
+
+def ulysses_attention(q, k, v, *, causal: bool = True,
+                      inner: Optional[Callable] = None):
+    """All-to-all head/sequence re-sharding attention (DeepSpeed-Ulysses
+    scheme, built after the reference's era).  Requires ``heads % sp == 0``."""
+    from deepspeed_tpu.ops.attention import reference_attention
+    inner = inner or reference_attention
+    B = mesh_lib.BATCH_AXES
+    # seq-sharded on entry (the transformer keeps activations seq-sharded);
+    # heads keep their Megatron 'tensor' sharding throughout
+    q, k, v = (_constrain(x, B, "seq", "tensor", None) for x in (q, k, v))
+    # a2a: full sequence, heads split over seq x tensor
+    q, k, v = (_constrain(x, B, None, ("seq", "tensor"), None) for x in (q, k, v))
+    o = inner(q, k, v, causal=causal)
+    # a2a back to seq-sharded
+    return _constrain(o, B, "seq", "tensor", None)
+
+
+def _ring_body(q, k, v, *, causal: bool, sp: int):
+    """shard_map body: q/k/v are local shards [B, Sl, H, D]."""
+    idx = jax.lax.axis_index("seq")
+    Bq, Sl, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(j, carry):
+        m, l, acc, kc, vc = carry
+        src = (idx - j) % sp
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)) * scale
+        if causal:
+            rows = idx * Sl + jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
+            cols = src * Sl + jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
+            s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))   # [B,H,Sl,1]
+        p = jnp.exp(s - m_new)                                        # [B,H,Sl,Sl]
+        alpha = jnp.exp(m - m_new)                                    # [B,H,Sl,1]
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        a = alpha[..., 0].transpose(0, 2, 1)[..., None]               # [B,Sl,H,1]
+        acc = acc * a + jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32))
+        kc = jax.lax.ppermute(kc, "seq", perm)
+        vc = jax.lax.ppermute(vc, "seq", perm)
+        return m_new, l, acc, kc, vc
+
+    m0 = jnp.full((Bq, H, Sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Bq, H, Sl, 1), jnp.float32)
+    a0 = jnp.zeros((Bq, Sl, H, D), jnp.float32)
+    m, l, acc, _, _ = jax.lax.fori_loop(0, sp, step, (m0, l0, a0, k, v))
+    linv = l[..., 0].transpose(0, 2, 1)[..., None]                    # [B,Sl,H,1]
+    return (acc / jnp.maximum(linv, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal: bool = True):
+    """Ring attention over the ``seq`` mesh axis (Liu et al. 2023 scheme,
+    pipelined KV ppermute).  Falls back to plain attention when sp == 1."""
+    from deepspeed_tpu.ops.attention import reference_attention
+    if not mesh_lib.has_mesh():
+        return reference_attention(q, k, v, causal=causal)
+    mesh = mesh_lib.get_mesh()
+    sp = int(mesh.shape["seq"])
+    if sp == 1:
+        return reference_attention(q, k, v, causal=causal)
+    # partial-manual: specs may only mention the manual axis; data/fsdp/
+    # tensor shardings stay automatic inside the body
+    spec = PartitionSpec(None, "seq", None, None)
+    fn = jax.shard_map(partial(_ring_body, causal=causal, sp=sp),
+                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                       axis_names={"seq"}, check_vma=False)
+    return fn(q, k, v)

@@ -44,7 +44,7 @@ class FlopsProfiler:
         self._t0 = None
         self.latency = 0.0
 
-    def start_profile(self, batch=None, ignore_list=None):
+    def start_profile(self, batch=None, ignore_list=None, num_micro_steps: int = 1):
         if self.started:
             return
         self.started = True
@@ -54,7 +54,7 @@ class FlopsProfiler:
                 cost = analyze_fn_cost(
                     lambda p, b: self.engine._value_and_grad(p, b, jax.random.PRNGKey(0), 1.0),
                     self.engine.state.params, batch)
-                self.flops_per_step = cost["flops"]
+                self.flops_per_step = cost["flops"] * num_micro_steps
             except Exception as e:
                 logger.debug(f"flops profile failed: {e}")
                 self.flops_per_step = 0.0

@@ -250,7 +250,7 @@ class DeepSpeedEngine:
 
         def fn(params, batch, rng, train):
             if isinstance(batch, dict) and "__kwargs__" in batch:
-                args, kw = batch["__args__"], batch["__kwargs__"]
+                args, kw = unpack(batch)
                 batch = args if len(args) != 1 else args[0]
                 return model(params, batch, rng, train, **kw)
             return model(params, batch, rng, train)
@@ -383,8 +383,10 @@ class DeepSpeedEngine:
         optimizer's ``step``; here it is a single XLA program with donated
         buffers.
         """
-        gas = self.gradient_accumulation_steps()
-        inv = 1.0 / (scaler.scale * gas)
+        # grads arrive as a SUM over gas micro-steps on the standard path;
+        # the PipelineEngine computes a mean inside its program and sets the
+        # divisor to 1 (a second division would shrink updates gas-fold).
+        inv = 1.0 / (scaler.scale * self._grad_accum_divisor())
         grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
 
         overflow = has_overflow(grads) if self.fp16_enabled else jnp.asarray(False)
@@ -468,7 +470,12 @@ class DeepSpeedEngine:
         sharding = mesh_lib.batch_sharding(self.mesh)
 
         def put(x):
+            if isinstance(x, jax.Array) and isinstance(getattr(x, "sharding", None),
+                                                       NamedSharding):
+                return x  # caller already placed it (e.g. PipelineEngine)
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            if x.ndim == 0:  # scalars (e.g. pld_theta kwarg) replicate
+                return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec()))
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
                 return multihost_utils.host_local_array_to_global_array(x, self.mesh,
@@ -533,6 +540,9 @@ class DeepSpeedEngine:
         self.timers(BACKWARD_MICRO_TIMER).stop(sync=False)
         return loss
 
+    def _grad_accum_divisor(self) -> float:
+        return float(self.gradient_accumulation_steps())
+
     def is_gradient_accumulation_boundary(self) -> bool:
         """True when the next ``step`` applies the optimizer (reference
         ``engine.py:is_gradient_accumulation_boundary``)."""
@@ -591,6 +601,10 @@ class DeepSpeedEngine:
             batch)
         if self._fused_step is None:
             self._fused_step = self._build_fused_step()
+        if self.flops_profiler:
+            # one micro-batch's cost x gas = the whole fused step
+            self.flops_profiler.start_profile(jax.tree.map(lambda x: x[0], batch),
+                                              num_micro_steps=self.gradient_accumulation_steps())
         self.tput_timer.start()
         carry = (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped)
         carry, loss, stats = self._fused_step(carry, batch, self._next_rng())
