@@ -67,12 +67,8 @@ def initialize(args=None,
 
 def init_inference(model=None, config=None, **kwargs):
     """Build an InferenceEngine (reference ``deepspeed/__init__.py:215``)."""
-    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
-    from deepspeed_tpu.inference.engine import InferenceEngine
-    cfg_dict = dict(config or {})
-    cfg_dict.update(kwargs)
-    ds_inference_config = DeepSpeedInferenceConfig(**cfg_dict)
-    return InferenceEngine(model, config=ds_inference_config)
+    from deepspeed_tpu.inference.engine import init_inference as _init
+    return _init(model=model, config=config, **kwargs)
 
 
 def add_config_arguments(parser):

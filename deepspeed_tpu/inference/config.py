@@ -1,0 +1,84 @@
+"""Inference config (reference ``deepspeed/inference/config.py:128``).
+
+Same JSON surface; TPU semantics noted per field:
+* ``tensor_parallel.tp_size``  -> size of the ``tensor`` mesh axis.
+* ``enable_cuda_graph``        -> no-op: every jitted decode program is
+  already captured/replayed by XLA (the reference's graph capture is
+  ``inference/engine.py:500-528``).
+* ``replace_with_kernel_inject`` -> selects the fused (Pallas) decode path
+  where available instead of the reference's CUDA kernel modules.
+"""
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: Any = Field(default=1, alias="num_experts")
+    type: str = "standard"
+
+
+class QuantTypeEnum:
+    asym = "asymmetric"
+    sym = "symmetric"
+
+
+class BaseQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    num_bits: int = 8
+    q_type: str = "symmetric"
+    q_groups: int = 1
+
+
+class WeightQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+
+
+class ActivationQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    activation: ActivationQuantConfig = ActivationQuantConfig()
+    weight: WeightQuantConfig = WeightQuantConfig()
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field(DeepSpeedTPConfig(), alias="tp")
+    enable_cuda_graph: bool = False
+    zero: Dict[str, Any] = {}
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: DeepSpeedMoEConfig = DeepSpeedMoEConfig()
+    quant: QuantizationConfig = QuantizationConfig()
+    max_tokens: int = Field(1024, alias="max_out_tokens")
+    min_out_tokens: int = Field(1, alias="min_out_tokens")
+    max_batch_size: Optional[int] = None
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    return_tuple: bool = True
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    seed: int = 0
+
+    @property
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+        return {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+                "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+                "int8": jnp.int8}[str(self.dtype).replace("torch.", "")]

@@ -1,0 +1,142 @@
+"""InferenceEngine (reference ``deepspeed/inference/engine.py:89``).
+
+The reference wraps an HF torch model, swaps its transformer blocks for
+fused CUDA modules (module_inject), builds an inference TP process group,
+and optionally captures CUDA graphs.  TPU-native redesign:
+
+* "Injection" = choosing the model's fused decode path: a model here is
+  an object implementing the DECODE PROTOCOL —
+  ``init_params(rng)`` / ``partition_specs()`` (optional) /
+  ``apply_with_cache(params, input_ids, cache) -> (logits, cache)`` /
+  ``init_cache(batch, max_len)`` / ``generate(...)`` — which the GPT
+  family implements via ``gpt_apply_with_cache`` (KV cache per layer,
+  the analogue of ``inference_context.h``'s workspace).
+* TP: parameters are placed by the model's partition specs over a mesh
+  whose ``tensor`` axis has ``tensor_parallel.tp_size`` devices — the
+  AutoTP analogue (``module_inject/auto_tp.py:13``) is that specs are
+  *derived from the model structure*, not hand-listed per architecture.
+* CUDA graphs -> jit: each (batch, seq) decode program is compiled once
+  and replayed; ``enable_cuda_graph`` is accepted and ignored.
+* Checkpoint loading accepts the training engine's checkpoints
+  (``load_checkpoint``) for the same model.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class InferenceEngine:
+
+    def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
+                 params=None, mesh=None, seed: int = 0):
+        self._config = config or DeepSpeedInferenceConfig()
+        self.module = model
+        self.dtype = self._config.jnp_dtype
+
+        # ---- mesh: inference TP group (reference :261) ----------------- #
+        if mesh is None:
+            if mesh_lib.has_mesh():
+                mesh = mesh_lib.get_mesh()
+            else:
+                tp = max(int(self._config.tensor_parallel.tp_size), 1)
+                n = jax.device_count()
+                assert n % tp == 0, f"tp_size {tp} does not divide {n} devices"
+                spec = mesh_lib.MeshSpec(tensor=tp, data=n // tp, device_count=n)
+                mesh = spec.build()
+                mesh_lib.set_mesh(mesh, spec)
+        self.mesh = mesh
+
+        # propagate inference dtype via a shallow model copy — never mutate
+        # the caller's model (it may be shared with a training engine)
+        if hasattr(model, "cfg") and hasattr(model.cfg, "dtype") \
+                and model.cfg.dtype != self.dtype:
+            import copy
+            import dataclasses
+            model = copy.copy(model)
+            model.cfg = dataclasses.replace(model.cfg, dtype=self.dtype)
+            self.module = model
+
+        # ---- parameters ------------------------------------------------ #
+        if params is None:
+            assert hasattr(model, "init_params"), (
+                "pass params= or a model with init_params(rng)")
+            params = model.init_params(jax.random.PRNGKey(seed))
+        specs = (model.partition_specs() if hasattr(model, "partition_specs")
+                 else jax.tree.map(lambda _: PartitionSpec(), params))
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s or PartitionSpec()), specs,
+            is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))
+        params = jax.tree.map(lambda p: jnp.asarray(p, self.dtype)
+                              if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+                              params)
+        self.params = jax.device_put(params, self.param_shardings)
+        self._generate_fns: Dict[Any, Callable] = {}
+        self._forward_fn = None
+        log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, "
+                 f"tp={int(self.mesh.shape['tensor'])}, "
+                 f"kernel_inject={self._config.replace_with_kernel_inject}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def load_checkpoint(self, load_dir, tag=None):
+        """Load weights saved by the training engine (reference sharded-
+        checkpoint load ``inference/engine.py:419``; resharding happens on
+        restore, the TPU analogue of MP-resize via state_dict_factory)."""
+        from deepspeed_tpu.runtime.checkpointing import load_params_only
+        self.params = load_params_only(load_dir, tag, self.params,
+                                       self.param_shardings, dtype=self.dtype)
+        return self
+
+    # ------------------------------------------------------------------ #
+    def forward(self, input_ids, *args, **kwargs):
+        """Full-sequence logits (one jitted program per input shape)."""
+        input_ids = jnp.asarray(input_ids)
+        if self._forward_fn is None:
+            model = self.module
+
+            def fwd(params, ids):
+                if hasattr(model, "forward_logits"):
+                    return model.forward_logits(params, ids)
+                logits, _ = model.apply_with_cache(
+                    params, ids, model.init_cache(ids.shape[0], ids.shape[1]))
+                return logits
+
+            self._forward_fn = jax.jit(fwd)
+        return self._forward_fn(self.params, input_ids)
+
+    __call__ = forward
+
+    def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
+                 rng=None, **kwargs):
+        """Autoregressive generation (reference patched ``generate`` :588).
+        One compiled program per (batch, prompt_len, max_new_tokens)."""
+        input_ids = jnp.asarray(input_ids)
+        key = (input_ids.shape, max_new_tokens, float(temperature))
+        if key not in self._generate_fns:
+            model = self.module
+
+            def gen(params, ids, r):
+                return model.generate(params, ids, max_new_tokens,
+                                      rng=r, temperature=temperature)
+
+            self._generate_fns[key] = jax.jit(gen)
+        r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
+        return self._generate_fns[key](self.params, input_ids, r)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Module-level helper mirroring ``deepspeed.init_inference``
+    (``deepspeed/__init__.py:215``): merge config dict + kwargs."""
+    cfg_dict = dict(config or {})
+    cfg_dict.update(kwargs)
+    mesh = cfg_dict.pop("mesh", None)
+    params = cfg_dict.pop("params", None)
+    ds_config = DeepSpeedInferenceConfig(**cfg_dict)
+    return InferenceEngine(model, config=ds_config, params=params, mesh=mesh)

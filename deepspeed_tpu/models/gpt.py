@@ -121,15 +121,15 @@ def _init_embed(cfg: GPTConfig, rng: Array) -> Dict:
 def init_gpt_params(cfg: GPTConfig, rng: Array) -> Dict:
     """Parameter pytree.  Block params are stacked ``[n_layer, ...]`` when
     ``scan_layers`` (matching the lax.scan body)."""
-    keys = jax.random.split(rng, 8)
+    k_embed, k_blocks = jax.random.split(rng)
     E, L = cfg.n_embd, cfg.n_layer
 
     if cfg.scan_layers:
-        blocks = jax.vmap(partial(_init_block, cfg))(jax.random.split(keys[2], L))
+        blocks = jax.vmap(partial(_init_block, cfg))(jax.random.split(k_blocks, L))
     else:
         blocks = {f"h{i}": _init_block(cfg, k)
-                  for i, k in enumerate(jax.random.split(keys[2], L))}
-    embed = _init_embed(cfg, jax.random.fold_in(keys[0], 0))
+                  for i, k in enumerate(jax.random.split(k_blocks, L))}
+    embed = _init_embed(cfg, k_embed)
     return {
         "wte": embed["wte"],
         "wpe": embed["wpe"],
@@ -178,12 +178,7 @@ def gpt_partition_specs(cfg: GPTConfig) -> Dict:
 # --------------------------------------------------------------------------- #
 # Forward
 # --------------------------------------------------------------------------- #
-def _constrain(x: Array, *spec) -> Array:
-    """Activation sharding constraint (no-op without a mesh)."""
-    if not mesh_lib.has_mesh():
-        return x
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh_lib.get_mesh(), PartitionSpec(*spec)))
+_constrain = mesh_lib.constrain
 
 
 def layer_norm(x: Array, g: Array, b: Array, eps: float = 1e-5) -> Array:
@@ -283,6 +278,118 @@ def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
     """Next-token cross-entropy, masking padded vocab entries."""
     logits = gpt_forward(cfg, params, input_ids, rng, train, attention_fn)
     return gpt_ce_loss_fn(cfg)(logits, labels)
+
+
+# --------------------------------------------------------------------------- #
+# Inference: KV cache + decode step (the analogue of the reference's
+# softmax_context kernel + inference_context.h workspace, SURVEY.md §2.3)
+# --------------------------------------------------------------------------- #
+def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict:
+    """Per-layer K/V cache, stacked [L, B, max_len, H, D] (scan-friendly).
+    Sharded: batch over DP axes, heads over tensor."""
+    L, H, D = cfg.n_layer, cfg.n_head, cfg.head_dim
+    shape = (L, batch, max_len, H, D)
+    k = jnp.zeros(shape, cfg.dtype)
+    v = jnp.zeros(shape, cfg.dtype)
+    spec = (None, mesh_lib.BATCH_AXES, None, "tensor", None)
+    return {"k": _constrain(k, *spec), "v": _constrain(v, *spec),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _cached_attention(q, ck, cv, pos):
+    """q: [B, S_q, H, D] attends causally to cache positions <= its own
+    global position (query i sits at ``pos + i``).  Static shapes:
+    full-cache attention with masking — the standard TPU decode pattern."""
+    B, Sq, H, D = q.shape
+    T = ck.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 1)
+    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 0)
+    mask = kpos <= qpos
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cv)
+
+
+def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
+                         cache: Dict) -> Tuple[Array, Dict]:
+    """Run ``input_ids`` [B, S_new] starting at cache position ``pos``;
+    returns (logits [B, S_new, V], updated cache).  Covers both prefill
+    (S_new = prompt length) and decode (S_new = 1) — one compiled program
+    per S_new."""
+    assert cfg.scan_layers, "KV-cache path requires scan_layers"
+    B, S = input_ids.shape
+    H, D, E = cfg.n_head, cfg.head_dim, cfg.n_embd
+    dt = cfg.dtype
+    pos = cache["pos"]
+
+    x = params["wte"].astype(dt)[input_ids]
+    x = x + params["wpe"].astype(dt)[jnp.clip(pos + jnp.arange(S), 0,
+                                              cfg.n_positions - 1)][None]
+    x = _constrain(x, mesh_lib.BATCH_AXES, None, None)
+
+    def layer(x, layer_in):
+        p, ck, cv = layer_in
+        h = layer_norm(x, p["ln1_g"], p["ln1_b"])
+        qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D)
+        k = k.reshape(B, S, H, D)
+        v = v.reshape(B, S, H, D)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=1)
+        o = _cached_attention(q, ck, cv, pos).reshape(B, S, E)
+        o = o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+        x = x + o
+        h = layer_norm(x, p["ln2_g"], p["ln2_b"])
+        h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
+        h = jax.nn.gelu(h, approximate=True)
+        h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+        return x + h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["blocks"], cache["k"], cache["v"]))
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = (x @ params["wte"].astype(dt).T).astype(jnp.float32)
+    new_cache = {"k": new_k, "v": new_v, "pos": pos + S}
+    return logits, new_cache
+
+
+def gpt_generate(cfg: GPTConfig, params: Dict, input_ids: Array,
+                 max_new_tokens: int, rng: Optional[Array] = None,
+                 temperature: float = 0.0, max_len: Optional[int] = None) -> Array:
+    """Greedy (temperature=0) or sampled autoregressive generation.
+    The decode loop is one ``lax.scan`` — a single compiled program for all
+    steps (the analogue of the reference's CUDA-graph'd generate,
+    ``inference/engine.py:500-528``)."""
+    B, S = input_ids.shape
+    assert S + max_new_tokens <= cfg.n_positions, (
+        f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+        f"n_positions ({cfg.n_positions}); the KV cache cannot grow past it")
+    max_len = max_len or (S + max_new_tokens)
+    cache = init_kv_cache(cfg, B, max_len)
+    logits, cache = gpt_apply_with_cache(cfg, params, input_ids, cache)
+    last = logits[:, -1]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, r):
+        if cfg.padded_vocab != cfg.vocab_size:
+            vmask = jnp.arange(cfg.padded_vocab) < cfg.vocab_size
+            logits = jnp.where(vmask[None], logits, -1e30)
+        if temperature and temperature > 0:
+            return jax.random.categorical(r, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, r):
+        cache, last_logits = carry
+        tok = sample(last_logits, r)
+        logits, cache = gpt_apply_with_cache(cfg, params, tok[:, None], cache)
+        return (cache, logits[:, -1]), tok
+
+    rngs = jax.random.split(rng, max_new_tokens)
+    (_, _), toks = jax.lax.scan(step, (cache, last), rngs)
+    return jnp.concatenate([input_ids, toks.T], axis=1)
 
 
 # --------------------------------------------------------------------------- #
@@ -414,6 +521,21 @@ class GPT:
 
     def partition_specs(self):
         return gpt_partition_specs(self.cfg)
+
+    # ---- inference decode protocol (InferenceEngine contract) --------- #
+    def init_cache(self, batch: int, max_len: int):
+        return init_kv_cache(self.cfg, batch, max_len)
+
+    def apply_with_cache(self, params, input_ids, cache):
+        return gpt_apply_with_cache(self.cfg, params, input_ids, cache)
+
+    def forward_logits(self, params, input_ids):
+        return gpt_forward(self.cfg, params, input_ids, rng=None, train=False)
+
+    def generate(self, params, input_ids, max_new_tokens, rng=None,
+                 temperature: float = 0.0):
+        return gpt_generate(self.cfg, params, input_ids, max_new_tokens,
+                            rng=rng, temperature=temperature)
 
     def num_params(self) -> int:
         cfg = self.cfg

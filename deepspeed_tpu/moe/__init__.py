@@ -1,0 +1,2 @@
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import top1gating, top2gating, TopKGate

@@ -1,0 +1,66 @@
+"""MoE layer (reference ``deepspeed/moe/layer.py:16``).
+
+The reference's ``MoE`` builds EP process groups (``:85`` via
+``utils/groups.py:108``) and wraps ``MOELayer`` + ``Experts``; here the
+``expert`` mesh axis IS the group and the layer is a functional module
+following the framework layer contract
+(``__call__(params, x, rng=None, train=False) -> (y, l_aux)``).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.moe.experts import Experts, FFNExpert
+from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_dispatch_combine
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+class MoE:
+    """Gated mixture-of-experts layer.
+
+    Args mirror the reference (``layer.py:16``): hidden_size, expert
+    (an expert module; default FFN), num_experts, ep_size (validated
+    against the mesh), k, capacity factors, min_capacity,
+    noisy_gate_policy, drop_tokens, use_rts.
+    """
+
+    def __init__(self, hidden_size: int, expert=None, num_experts: int = 1,
+                 ep_size: int = 1, k: int = 1, capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None, drop_tokens: bool = True,
+                 use_rts: bool = True, expert_hidden: Optional[int] = None):
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        expert = expert or FFNExpert(hidden_size, expert_hidden or 4 * hidden_size)
+        self.experts = Experts(expert, num_experts)
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor,
+                             eval_capacity_factor, min_capacity, noisy_gate_policy,
+                             drop_tokens, use_rts)
+        if mesh_lib.has_mesh():
+            ep = mesh_lib.get_expert_parallel_world_size()
+            assert num_experts % max(ep, 1) == 0, (
+                f"num_experts {num_experts} not divisible by expert mesh axis {ep}")
+
+    def init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"gate": self.gate.init_params(k1),
+                "experts": self.experts.init_params(k2)}
+
+    def partition_specs(self):
+        return {"gate": {"wg": PartitionSpec()},
+                "experts": self.experts.partition_specs()}
+
+    def __call__(self, params, x, rng=None, train=False):
+        """x: [..., M] (any leading dims) -> (y same shape, l_aux, exp_counts)."""
+        lead = x.shape[:-1]
+        M = x.shape[-1]
+        xt = x.reshape(-1, M)
+        l_aux, combine, dispatch, exp_counts = self.gate(params["gate"], xt,
+                                                         rng=rng, train=train)
+        y = moe_dispatch_combine(xt, combine, dispatch, self.experts.expert,
+                                 params["experts"])
+        return y.reshape(*lead, M).astype(x.dtype), l_aux, exp_counts

@@ -164,6 +164,16 @@ def get_sequence_parallel_world_size() -> int:
     return axis_size("seq")
 
 
+def constrain(x, *spec):
+    """Activation sharding constraint on the global mesh; no-op when no
+    mesh is set (single place for the has_mesh/with_sharding_constraint
+    idiom used by models, MoE and sequence parallelism)."""
+    if not has_mesh():
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(get_mesh(), PartitionSpec(*spec)))
+
+
 def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     """Sharding for a [batch, ...] array: batch split over data+fsdp."""
     mesh = mesh or get_mesh()

@@ -33,11 +33,7 @@ from deepspeed_tpu.parallel import mesh as mesh_lib
 NEG_INF = -1e30
 
 
-def _constrain(x, *spec):
-    if mesh_lib.has_mesh():
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh_lib.get_mesh(), PartitionSpec(*spec)))
-    return x
+_constrain = mesh_lib.constrain
 
 
 def ulysses_attention(q, k, v, *, causal: bool = True,

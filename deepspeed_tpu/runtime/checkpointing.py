@@ -129,6 +129,36 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     return ckpt_dir, client_state
 
 
+def load_params_only(load_dir: str, tag: Optional[str], params, shardings,
+                     dtype=None):
+    """Restore just the parameter pytree from a training checkpoint
+    (used by the InferenceEngine; reference analogue: sharded ckpt load
+    ``inference/engine.py:419``).  ``params`` supplies shapes; restore
+    reshards onto ``shardings`` and casts to ``dtype``."""
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        with open(latest) as f:
+            tag = f.read().strip()
+    state_path = os.path.join(load_dir, str(tag), "state")
+    assert os.path.isdir(state_path), f"checkpoint {state_path} not found"
+    # saved params are fp32 masters; restore at fp32 then cast.
+    # Partial restore: only the "params" subtree is read (optimizer state
+    # stays on disk — it can be 2x the params).
+    import orbax.checkpoint as ocp
+    target = {"params": jax.tree.map(
+        lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, jnp.float32, sharding=s),
+        params, shardings)}
+    restored = _checkpointer().restore(
+        state_path, args=ocp.args.PyTreeRestore(item=target,
+                                                partial_restore=True))["params"]
+    if dtype is not None:
+        restored = jax.tree.map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            restored)
+    log_dist(f"loaded params from {state_path}", ranks=[0])
+    return restored
+
+
 def _abstract(tree, shardings):
     return jax.tree.map(
         lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=s),

@@ -502,7 +502,8 @@ class DeepSpeedEngine:
             batch = inputs if len(inputs) != 1 else inputs[0]
         batch = self._place_batch(batch)
         if self.flops_profiler:
-            self.flops_profiler.start_profile(batch)
+            self.flops_profiler.start_profile(
+                batch, num_micro_steps=self.gradient_accumulation_steps())
         self.timers(FORWARD_MICRO_TIMER).start(sync=False)
 
         if self._in_training_mode:

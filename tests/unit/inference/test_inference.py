@@ -1,0 +1,83 @@
+"""Inference stack tests (coverage model: reference
+``tests/unit/inference/test_inference.py`` parametrized sweep): KV-cache
+decode parity vs full forward, generation determinism, TP inference, and
+train->infer checkpoint handoff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt import (GPT, gpt_config, gpt_forward,
+                                      gpt_apply_with_cache, init_kv_cache)
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.parallel.mesh import MeshSpec
+
+
+def tiny():
+    return gpt_config("tiny", attn_impl="reference", dtype=jnp.float32)
+
+
+def test_cache_prefill_matches_forward():
+    cfg = tiny()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, cfg.vocab_size)
+    full = gpt_forward(cfg, params, ids)
+    cached, cache = gpt_apply_with_cache(cfg, params, ids, init_kv_cache(cfg, 2, 32))
+    np.testing.assert_allclose(np.asarray(full), np.asarray(cached), atol=1e-4, rtol=1e-4)
+    assert int(cache["pos"]) == 24
+
+
+def test_incremental_decode_matches_full():
+    """Prefill + one-token decode == full forward on the extended sequence."""
+    cfg = tiny()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(2), (1, 16), 0, cfg.vocab_size)
+    nxt = jax.random.randint(jax.random.PRNGKey(3), (1, 1), 0, cfg.vocab_size)
+    _, cache = gpt_apply_with_cache(cfg, params, ids, init_kv_cache(cfg, 1, 32))
+    step_logits, _ = gpt_apply_with_cache(cfg, params, nxt, cache)
+    full = gpt_forward(cfg, params, jnp.concatenate([ids, nxt], axis=1))
+    np.testing.assert_allclose(np.asarray(step_logits[:, 0]), np.asarray(full[:, -1]),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_init_inference_generate():
+    cfg = tiny()
+    engine = deepspeed_tpu.init_inference(model=GPT(cfg), config={
+        "dtype": "float32", "tensor_parallel": {"tp_size": 2}})
+    ids = jnp.asarray([[5, 7, 11]], jnp.int32)
+    out = engine.generate(ids, max_new_tokens=5)
+    assert out.shape == (1, 8)
+    # greedy decode is deterministic
+    out2 = engine.generate(ids, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # logits forward works and is vocab-shaped
+    logits = engine(ids)
+    assert logits.shape == (1, 3, cfg.padded_vocab)
+
+
+def test_train_then_infer_checkpoint(tmp_path):
+    """save_checkpoint from training -> InferenceEngine.load_checkpoint."""
+    mesh_lib.reset_mesh()
+    cfg = tiny()
+    model = GPT(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+    })
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8, 16), 0, cfg.vocab_size)
+    engine.train_batch(batch=(ids, ids))
+    engine.save_checkpoint(str(tmp_path))
+
+    inf = deepspeed_tpu.init_inference(model=GPT(cfg), config={"dtype": "float32"})
+    inf.load_checkpoint(str(tmp_path))
+    trained_wte = np.asarray(jax.device_get(engine.get_fp32_params()["wte"]))
+    loaded_wte = np.asarray(jax.device_get(
+        jax.jit(lambda p: p, out_shardings=jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(inf.mesh, jax.sharding.PartitionSpec()),
+            inf.param_shardings))(inf.params)["wte"]))
+    np.testing.assert_allclose(trained_wte, loaded_wte, atol=1e-6)

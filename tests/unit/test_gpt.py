@@ -54,7 +54,7 @@ def test_gpt_trains_with_tp_and_zero(stage):
     config = {
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "optimizer": {"type": "adam", "params": {"lr": 3e-3}},
         "zero_optimization": {"stage": stage},
         "bf16": {"enabled": True},
     }
