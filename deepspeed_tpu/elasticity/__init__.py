@@ -1,0 +1,5 @@
+from deepspeed_tpu.elasticity.elasticity import (ElasticityConfig, ElasticityConfigError,
+                                                 ElasticityError,
+                                                 ElasticityIncompatibleWorldSize,
+                                                 compute_elastic_config,
+                                                 elasticity_enabled)
