@@ -9,6 +9,23 @@ operands.
 Layout convention here is [batch, heads, seq, head_dim]; the public wrapper
 (`flash_attention`) takes the framework-wide [batch, seq, heads, head_dim].
 
+Mosaic layout notes (learned the hard way — round 1 shipped an lse output
+of shape [B, H, S] with block (1, 1, bq), which Mosaic rejects because the
+second-to-last block dim (1) is neither a multiple of the sublane tile nor
+equal to H): every operand/result carries the row-statistics (lse, delta)
+as [B, H, S, 1] so the trailing two block dims (bq, 1) are (sublane-multiple,
+full-dim) — always legal.
+
+SPMD: ``pallas_call`` has no partitioning rule, so the public wrapper runs
+the kernel under ``shard_map`` over the batch (data/fsdp/expert) and head
+(seq × tensor) mesh axes whenever a global mesh is active.  Putting the
+``seq`` axis on the HEAD dim (sequence replicated inside the kernel) makes
+the wrapper itself the Ulysses all-to-all: activations arriving
+sequence-sharded are re-sharded by jit to head-sharded full-sequence form,
+the exact re-shard ``parallel/sequence.py:ulysses_attention`` expresses as
+sharding constraints.  Ring attention (O(S/sp) memory) remains the explicit
+alternative for sequences too long to replicate per-device.
+
 ``interpret=True`` (automatic off-TPU) runs the same kernels through the
 Pallas interpreter so CPU CI validates them against the jnp reference — the
 analogue of the reference's kernel-vs-HF-modeling parity tests
@@ -22,8 +39,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
+
+_PARALLEL3 = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel"))
 
 
 def _interpret() -> bool:
@@ -76,7 +98,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, S
     a0 = jnp.zeros((bq, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, a0))
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0, 0] = m + jnp.log(l)        # [bq, 1]
 
 
 def _fwd(q, k, v, *, causal, scale, bq=None, bk=None):
@@ -93,12 +115,13 @@ def _fwd(q, k, v, *, causal, scale, bq=None, bk=None):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
         ],
+        compiler_params=_PARALLEL3,
         interpret=_interpret(),
     )(q, k, v)
     return o, lse
@@ -112,8 +135,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]          # [bq, 1]
-    delta = delta_ref[0, 0][:, None]      # [bq, 1]
+    lse = lse_ref[0, 0]                   # [bq, 1]
+    delta = delta_ref[0, 0]               # [bq, 1]
     D = q.shape[-1]
 
     num_kb = pl.cdiv((qi + 1) * bq, bk) if causal else S // bk
@@ -151,8 +174,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]
-        delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq), :]       # [bq, 1]
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq), :]   # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -179,12 +202,13 @@ def _bwd(causal, scale, bq, bk, res, do):
     q, k, v, o, lse = res
     B, H, S, D = q.shape
     bq_, bk_ = _block_sizes(S, bq, bk)
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # [B,H,S]
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)                     # [B,H,S,1]
 
     qspec = pl.BlockSpec((1, 1, bq_, D), lambda b, h, i: (b, h, i, 0))
     full = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0))
-    vec_q = pl.BlockSpec((1, 1, bq_), lambda b, h, i: (b, h, i))
-    vec_full = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
+    vec_q = pl.BlockSpec((1, 1, bq_, 1), lambda b, h, i: (b, h, i, 0))
+    vec_full = pl.BlockSpec((1, 1, S, 1), lambda b, h, i: (b, h, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq_, bk=bk_, S=S),
@@ -192,6 +216,7 @@ def _bwd(causal, scale, bq, bk, res, do):
         in_specs=[qspec, full, full, qspec, vec_q, vec_q],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        compiler_params=_PARALLEL3,
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
@@ -203,6 +228,7 @@ def _bwd(causal, scale, bq, bk, res, do):
         out_specs=[kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
                    jax.ShapeDtypeStruct((B, H, S, D), v.dtype)],
+        compiler_params=_PARALLEL3,
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -222,14 +248,41 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
+def _flash_bshd(q, k, v, causal, scale, bq, bk):
+    """[B,S,H,D] wrapper around the [B,H,S,D] kernel."""
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o = _flash(qt, kt, vt, causal, scale, bq, bk)
+    return o.transpose(0, 2, 1, 3)
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     block_q: Optional[int] = None, block_k: Optional[int] = None):
-    """[batch, seq, heads, head_dim] flash attention (differentiable)."""
+    """[batch, seq, heads, head_dim] flash attention (differentiable).
+
+    Under an active mesh the kernel runs inside ``shard_map`` with batch
+    sharded over the data/fsdp/expert axes and heads over seq × tensor
+    (sequence-sharded inputs are thereby Ulysses-re-sharded to full-seq,
+    split-head form before the kernel — see module docstring)."""
     B, S, H, D = q.shape
     if S % min(128, S) != 0:
         from deepspeed_tpu.ops.attention import reference_attention
         return reference_attention(q, k, v, causal=causal)
     scale = 1.0 / np.sqrt(D)
-    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    o = _flash(qt, kt, vt, causal, scale, block_q, block_k)
-    return o.transpose(0, 2, 1, 3)
+
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    if mesh_lib.has_mesh():
+        mesh = mesh_lib.get_mesh()
+        batch_div = int(np.prod([mesh.shape[a] for a in mesh_lib.BATCH_AXES]))
+        head_div = int(mesh.shape["tensor"] * mesh.shape["seq"])
+        if batch_div > 1 or head_div > 1:
+            if B % batch_div != 0 or H % head_div != 0:
+                # a bare pallas_call has no SPMD partitioning rule; on shapes
+                # the shard_map can't split, use the jnp path XLA can shard
+                from deepspeed_tpu.ops.attention import reference_attention
+                return reference_attention(q, k, v, causal=causal)
+            spec = P(mesh_lib.BATCH_AXES, None, ("seq", "tensor"), None)
+            inner = functools.partial(_flash_bshd, causal=causal, scale=scale,
+                                      bq=block_q, bk=block_k)
+            return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False)(q, k, v)
+    return _flash_bshd(q, k, v, causal, scale, block_q, block_k)

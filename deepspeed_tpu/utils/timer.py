@@ -166,74 +166,95 @@ class NoopTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec tracker (reference ``utils/timer.py:137``).
+    """Samples/sec tracker (the role of reference ``utils/timer.py:137``).
 
-    ``batch_size`` is the *global* train batch per step.  Reports every
-    ``steps_per_output`` steps via ``log_dist``.
+    TPU-native design point: never fence the device on a per-step basis.
+    Dispatch is fully asynchronous (and on tunneled runtimes a device sync
+    costs a network round-trip), so a per-step start/stop sync — the
+    reference's CUDA-event pattern — serializes the pipeline and *is itself*
+    the bottleneck.  Instead, steps are only counted between report
+    boundaries; the device is drained once per ``steps_per_output`` window
+    and throughput is window_samples / window_time.
+
+    ``batch_size`` is the *global* train batch per step.
     """
 
-    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
-        self.start_time = 0
-        self.end_time = 0
-        self.started = False
+    def __init__(self, batch_size, start_step=2, steps_per_output=50,
+                 monitor_memory=False, logging_fn=None):
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
+        self.steps_per_output = max(1, steps_per_output)
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or log_dist
+        self.started = False
         self.epoch_count = 0
         self.micro_step_count = 0
         self.global_step_count = 0
-        self.total_elapsed_time = 0
-        self.step_elapsed_time = 0
-        self.steps_per_output = steps_per_output
-        self.monitor_memory = monitor_memory
-        self.logging = logging_fn
-        if self.logging is None:
-            self.logging = log_dist
-        self.initialized = False
+        # measurement window (between device drains)
+        self._window_start: float = 0.0
+        self._window_step0 = 0
+        self._last_stop: float = 0.0
+        self._excluded = 0.0   # host time between stop() and the next start()
+        # lifetime accumulation over *measured* windows only
+        self.total_elapsed_time = 0.0
+        self._measured_steps = 0
 
     def update_epoch_count(self):
         self.epoch_count += 1
         self.micro_step_count = 0
 
-    def _init_timer(self):
-        self.initialized = True
-
     def start(self):
-        self._init_timer()
         self.started = True
-        if self.global_step_count >= self.start_step:
+        if self._window_start == 0.0 and self.global_step_count >= self.start_step:
             _sync_device()
-            self.start_time = time.time()
+            self._window_start = time.time()
+            self._window_step0 = self.global_step_count
+            self._excluded = 0.0
+        elif self._last_stop > 0.0:
+            # host-side time spent outside train steps (eval, data loading,
+            # checkpointing) is not training throughput; device-async work
+            # from those calls may still bleed in, but host stalls dominate
+            self._excluded += time.time() - self._last_stop
+            self._last_stop = 0.0
+
+    def _close_window(self, report_speed):
+        _sync_device()
+        now = time.time()
+        window = self.global_step_count - self._window_step0
+        duration = max(now - self._window_start - self._excluded, 1e-9)
+        self.total_elapsed_time += duration
+        self._measured_steps += window
+        if report_speed and window > 0:
+            self.logging(
+                f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                f"global_step={self.global_step_count}, RunningAvgSamplesPerSec="
+                f"{self.avg_samples_per_sec():.3f}, CurrSamplesPerSec="
+                f"{self.batch_size * window / duration:.3f}")
+        self._window_start = now
+        self._window_step0 = self.global_step_count
+        self._excluded = 0.0
 
     def stop(self, global_step=False, report_speed=True):
         if not self.started:
             return
         self.started = False
         self.micro_step_count += 1
-        if global_step:
-            self.global_step_count += 1
-        if self.start_time > 0:
-            _sync_device()
-            self.end_time = time.time()
-            duration = self.end_time - self.start_time
-            self.total_elapsed_time += duration
-            self.step_elapsed_time += duration
-            if global_step:
-                if report_speed and self.global_step_count % self.steps_per_output == 0:
-                    self.logging(
-                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                        f"global_step={self.global_step_count}, RunningAvgSamplesPerSec="
-                        f"{self.avg_samples_per_sec():.3f}, CurrSamplesPerSec="
-                        f"{self.batch_size / self.step_elapsed_time:.3f}")
-                self.step_elapsed_time = 0
+        if not global_step:
+            return
+        self.global_step_count += 1
+        self._last_stop = time.time()
+        if (self._window_start > 0.0
+                and self.global_step_count - self._window_step0 >= self.steps_per_output):
+            self._close_window(report_speed)
 
     def avg_samples_per_sec(self):
-        if self.global_step_count > 0 and self.total_elapsed_time > 0:
-            samples_per_step = self.batch_size
-            total_step_offset = self.global_step_count - self.start_step
-            if total_step_offset <= 0:
-                return 0.0
-            avg_time_per_step = self.total_elapsed_time / total_step_offset
-            return samples_per_step / avg_time_per_step
+        if (self._measured_steps == 0 and self._window_start > 0.0
+                and self.global_step_count > self._window_step0):
+            # run shorter than one report window: close it now so short
+            # trainings still report a measured value
+            self._close_window(report_speed=False)
+        if self._measured_steps > 0 and self.total_elapsed_time > 0:
+            return self.batch_size * self._measured_steps / self.total_elapsed_time
         return 0.0
 
 

@@ -51,6 +51,41 @@ def test_backward_parity(causal):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.parametrize("axes", [dict(data=2, fsdp=2, tensor=2),
+                                  dict(data=2, seq=2, tensor=2)])
+def test_sharded_flash_under_mesh(axes):
+    """Pallas path under an active mesh: the shard_map wrapper must shard
+    batch over data/fsdp and heads over seq x tensor and still match the
+    reference (grads included) — the multichip SPMD path the advisor
+    flagged as unvalidated.  The seq=2 case exercises the built-in
+    Ulysses re-shard of sequence-sharded inputs."""
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+
+    spec = mesh_lib.MeshSpec(device_count=8, **axes)
+    mesh = spec.build(jax.devices()[:8])
+    mesh_lib.set_mesh(mesh, spec)
+    try:
+        q, k, v = make_qkv(B=4, S=128, H=4, D=32, seed=4)
+
+        @jax.jit
+        def run(q, k, v):
+            return flash_attention(q, k, v, causal=True)
+
+        out = run(q, k, v)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+        g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(
+            reference_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, g_ref, "qkv"):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                       err_msg=f"d{name} mismatch")
+    finally:
+        mesh_lib.reset_mesh()
+
+
 def test_bf16_close():
     q, k, v = make_qkv(B=1, S=128, H=2, D=64, dtype=jnp.bfloat16, seed=2)
     out = flash_attention(q, k, v, causal=True)
