@@ -11,6 +11,7 @@ from typing import Optional
 from deepspeed_tpu.version import __version__
 from deepspeed_tpu import comm
 from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.runtime import zero  # deepspeed.zero.Init / GatheredParameters
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.utils.logging import log_dist, logger
 

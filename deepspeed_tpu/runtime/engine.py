@@ -258,21 +258,68 @@ class DeepSpeedEngine:
         return fn
 
     def _init_parameters(self, model, model_parameters):
-        if model_parameters is None and hasattr(model, "init_params"):
-            model_parameters = model.init_params(self._next_rng())
-        assert model_parameters is not None, (
-            "Pass model_parameters (an initialized parameter pytree) or use a model "
-            "with .init_params(rng)")
-        # fp32 master copy, placed per ZeRO policy (stage 3 shards, else replicated)
-        params32 = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
+        """Build fp32 master parameters directly into their ZeRO shards.
+
+        The reference shards at construction via ``zero.Init``
+        (``partition_parameters.py:516``); round-1 of this engine built the
+        FULL fp32 pytree first and sharded after — fatal for the model class
+        ZeRO-3 exists for.  Now the init function runs under jit with
+        sharded ``out_shardings`` (planned from ``jax.eval_shape``), so each
+        device materializes only its own shard and the unsharded tree never
+        exists.  A host pytree passed as ``model_parameters`` is placed
+        slice-wise instead (one full copy in host RAM, never in HBM).
+        """
+        from deepspeed_tpu.runtime.zero import partition_parameters as zinit
+
         # Tensor-parallel (logical) specs from the model, composed under fsdp
         # (the TPU analogue of Megatron TP + ZeRO stacking).
         self._logical_specs = (model.partition_specs()
                                if hasattr(model, "partition_specs") else None)
-        self.param_shardings = self.zero_policy.param_shardings(params32, self._logical_specs)
-        self.state.params = jax.device_put(params32, self.param_shardings)
-        self.grad_shardings = self.zero_policy.grad_shardings(params32, self._logical_specs)
-        nparams = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params32))
+        policy = self.zero_policy
+        if zinit.init_ctx_active() and policy.stage < 3:
+            # zero.Init implies partitioned construction (reference behavior);
+            # below stage 3 the mesh has no fsdp axis, so partition over all
+            # data-parallel axes (the reference shards over every DP rank).
+            # The widened policy becomes THE engine policy — grads and
+            # optimizer state must shard consistently with the params, or
+            # the 2x-params Adam state would stay replicated and defeat the
+            # memory purpose of zero.Init.
+            policy = ZeroShardingPolicy(self.mesh, stage=3, min_size=policy.min_size,
+                                        axes=("data", "fsdp"))
+            self.zero_policy = policy
+
+        oc = self._config.zero_config.offload_param
+        if model_parameters is None and hasattr(model, "init_params"):
+            rng = self._next_rng()
+            shapes = jax.eval_shape(model.init_params, rng)
+            self.param_shardings = policy.param_shardings(shapes, self._logical_specs)
+            if oc is not None and policy.stage >= 3:
+                self.param_shardings = zinit.offload_shardings(self.param_shardings, oc.device)
+
+            def build(r):
+                return jax.tree.map(lambda p: p.astype(jnp.float32), model.init_params(r))
+
+            self.state.params = jax.jit(build, out_shardings=self.param_shardings)(rng)
+        else:
+            assert model_parameters is not None, (
+                "Pass model_parameters (an initialized parameter pytree) or use a "
+                "model with .init_params(rng)")
+
+            def to_f32(p):
+                # leave already-placed jax.Arrays on device (device_put below
+                # reshards device-to-device); only host leaves go via numpy
+                if isinstance(p, jax.Array):
+                    return p if p.dtype == jnp.float32 else p.astype(jnp.float32)
+                return np.asarray(p, np.float32)
+
+            params32 = jax.tree.map(to_f32, model_parameters)
+            self.param_shardings = policy.param_shardings(params32, self._logical_specs)
+            if oc is not None and policy.stage >= 3:
+                self.param_shardings = zinit.offload_shardings(self.param_shardings, oc.device)
+            self.state.params = jax.tree.map(jax.device_put, params32, self.param_shardings)
+
+        self.grad_shardings = policy.grad_shardings(self.state.params, self._logical_specs)
+        nparams = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.state.params))
         self._num_params = nparams
         log_dist(f"model parameters: {nparams:,}", ranks=[0])
 
@@ -311,10 +358,10 @@ class DeepSpeedEngine:
         opt_shapes = jax.eval_shape(tx.init, self.state.params)
         self.opt_shardings = self.zero_policy.opt_shardings(opt_shapes, self.state.params,
                                                            getattr(self, "_logical_specs", None))
-        self.opt_shardings = self._maybe_offload(self.opt_shardings)
+        self.opt_shardings = self._maybe_offload(self.opt_shardings, opt_shapes)
         self.state.opt_state = jax.jit(tx.init, out_shardings=self.opt_shardings)(self.state.params)
 
-    def _maybe_offload(self, shardings):
+    def _maybe_offload(self, shardings, opt_shapes):
         """ZeRO-Offload: place optimizer state in host memory
         (reference ``offload_optimizer.device=cpu`` → CPUAdam path,
         ``stage_1_and_2.py`` cpu_offload; here a memory_kind annotation and
@@ -322,15 +369,24 @@ class DeepSpeedEngine:
         oc = self._config.zero_config.offload_optimizer
         if oc is None or oc.device in (None, "none"):
             return shardings
-        try:
-            return jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), shardings)
-        except Exception as e:
-            logger.warning(f"optimizer offload requested but unsupported on this backend: {e}")
-            return shardings
+        from deepspeed_tpu.runtime.zero.partition_parameters import offload_shardings
+        return offload_shardings(shardings, oc.device, shapes=opt_shapes)
 
     # ------------------------------------------------------------------ #
     # Compiled step programs
     # ------------------------------------------------------------------ #
+    def _device_view(self, tree, shardings):
+        """Copy host-offloaded (pinned_host) leaves into device memory inside
+        a jitted program — the XLA host-offload idiom: compute happens on
+        HBM views, out_shardings stream results back to the host tier (the
+        role of the reference's swap-in/swap-out around CPUAdam,
+        ``stage_1_and_2.py`` cpu_offload)."""
+        def view(x, s):
+            if isinstance(s, NamedSharding) and s.memory_kind == "pinned_host":
+                return jax.device_put(x, s.with_memory_kind("device"))
+            return x
+        return jax.tree.map(view, tree, shardings)
+
     def _cast_batch(self, batch):
         """Cast floating inputs to the compute dtype (the reference casts
         inputs in ``engine.py:_cast_inputs`` when fp16/bf16 enabled)."""
@@ -340,6 +396,7 @@ class DeepSpeedEngine:
 
     def _value_and_grad(self, params, batch, rng, scale):
         batch = self._cast_batch(batch)
+        params = self._device_view(params, self.param_shardings)
 
         def scaled_loss(p):
             cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), p)
@@ -362,6 +419,7 @@ class DeepSpeedEngine:
     def _build_eval_step(self):
         @jax.jit
         def eval_step(params, batch, rng):
+            params = self._device_view(params, self.param_shardings)
             cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), params)
             out = self._loss_fn(cast, self._cast_batch(batch), rng, False)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
@@ -383,6 +441,8 @@ class DeepSpeedEngine:
         optimizer's ``step``; here it is a single XLA program with donated
         buffers.
         """
+        params = self._device_view(params, self.param_shardings)
+        opt_state = self._device_view(opt_state, self.opt_shardings)
         # grads arrive as a SUM over gas micro-steps on the standard path;
         # the PipelineEngine computes a mean inside its program and sets the
         # divisor to 1 (a second division would shrink updates gas-fold).

@@ -1,2 +1,4 @@
 from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.partition_parameters import (GatheredParameters, Init,
+                                                             materialize, scatter_to)
 from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy, zero_partition_spec

@@ -32,9 +32,10 @@ Pytree = Any
 
 
 def zero_partition_spec(shape, fsdp_size: int, min_size: int = 2**12,
-                        existing: Optional[PartitionSpec] = None) -> PartitionSpec:
-    """PartitionSpec sharding one dim over 'fsdp', composed with an existing
-    (e.g. tensor-parallel) spec."""
+                        existing: Optional[PartitionSpec] = None,
+                        axes=("fsdp",)) -> PartitionSpec:
+    """PartitionSpec sharding one dim over the ZeRO axes (default 'fsdp'),
+    composed with an existing (e.g. tensor-parallel) spec."""
     existing = existing or PartitionSpec()
     n = int(np.prod(shape)) if shape else 1
     if fsdp_size <= 1 or n < max(min_size, fsdp_size):
@@ -48,29 +49,36 @@ def zero_partition_spec(shape, fsdp_size: int, min_size: int = 2**12,
             best, best_size = d, shape[d]
     if best is None:
         return existing
-    spec[best] = "fsdp"
+    spec[best] = axes if len(axes) > 1 else axes[0]
     while spec and spec[-1] is None:
         spec.pop()
     return PartitionSpec(*spec)
 
 
-def _leaf_spec(leaf, fsdp_size, min_size, logical_spec=None):
+def _leaf_spec(leaf, fsdp_size, min_size, logical_spec=None, axes=("fsdp",)):
     shape = np.shape(leaf) if not hasattr(leaf, "shape") else leaf.shape
-    return zero_partition_spec(shape, fsdp_size, min_size, existing=logical_spec)
+    return zero_partition_spec(shape, fsdp_size, min_size, existing=logical_spec,
+                               axes=axes)
 
 
 class ZeroShardingPolicy:
-    """Computes shardings for params / grads / optimizer state per stage."""
+    """Computes shardings for params / grads / optimizer state per stage.
 
-    def __init__(self, mesh: Mesh, stage: int, min_size: int = 2**12):
+    ``axes`` are the mesh axes ZeRO partitions over — ('fsdp',) normally;
+    ``zero.Init`` outside a stage-3 config widens it to ('data', 'fsdp')
+    (the reference partitions over every DP rank)."""
+
+    def __init__(self, mesh: Mesh, stage: int, min_size: int = 2**12,
+                 axes=("fsdp",)):
         self.mesh = mesh
         self.stage = stage
         self.min_size = min_size
-        self.fsdp_size = int(mesh.shape["fsdp"])
+        self.axes = tuple(a for a in axes if int(mesh.shape[a]) > 1) or ("fsdp",)
+        self.fsdp_size = int(np.prod([mesh.shape[a] for a in self.axes]))
 
     def _sharded(self, tree: Pytree, logical_specs: Optional[Pytree] = None) -> Pytree:
         def make(leaf, lspec=None):
-            spec = _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec)
+            spec = _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec, self.axes)
             return NamedSharding(self.mesh, spec)
         if logical_specs is None:
             return jax.tree.map(make, tree)
@@ -127,7 +135,8 @@ class ZeroShardingPolicy:
             return tuple(out)
 
         param_paths = [(path_keys(path), tuple(leaf.shape),
-                        _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec))
+                        _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec,
+                                   self.axes))
                        for (path, leaf), lspec in zip(
                            jax.tree_util.tree_flatten_with_path(params)[0],
                            jax.tree.leaves(lspecs, is_leaf=is_spec_leaf))]
@@ -145,7 +154,8 @@ class ZeroShardingPolicy:
             if best is not None:
                 return best[1]
             # no path match (e.g. flattened/custom state): derive from shape
-            return zero_partition_spec(shape, self.fsdp_size, self.min_size)
+            return zero_partition_spec(shape, self.fsdp_size, self.min_size,
+                                       axes=self.axes)
 
         flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state_shapes)
         shardings = [NamedSharding(self.mesh, lookup(path, tuple(getattr(leaf, "shape", ()))))

@@ -233,6 +233,9 @@ class ThroughputTimer:
         self._window_start = now
         self._window_step0 = self.global_step_count
         self._excluded = 0.0
+        # the drain above is window compute time, not an out-of-step gap;
+        # clearing _last_stop keeps the next start() from excluding it
+        self._last_stop = 0.0
 
     def stop(self, global_step=False, report_speed=True):
         if not self.started:

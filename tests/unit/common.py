@@ -1,0 +1,32 @@
+"""Shared test helpers (the role of the reference's ``tests/unit/common.py``
+DistributedExec harness — here, TPU-hardware child-process checks).
+
+The test session runs on a forced virtual CPU mesh (tests/conftest.py), so
+anything that must execute on real TPU hardware runs a tool script from
+``tools/`` in a child process with the default backend.  Tools print
+``PASS``/``SKIP`` and exit 0; callers skip on SKIP."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# env that would force the child onto the CPU mesh / dryrun path
+_FORCED_BACKEND_ENVS = ("JAX_PLATFORMS", "XLA_FLAGS", "_GRAFT_DRYRUN_CHILD")
+
+
+def run_tpu_tool(tool_name: str, timeout: int = 600):
+    """Run ``tools/<tool_name>`` with a clean backend env; assert rc 0 and
+    pytest.skip when the tool reports no TPU attached."""
+    env = {k: v for k, v in os.environ.items() if k not in _FORCED_BACKEND_ENVS}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", tool_name)],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"{tool_name} child failed:\n{out}"
+    if "SKIP" in proc.stdout:
+        pytest.skip("no TPU attached")
+    return proc.stdout

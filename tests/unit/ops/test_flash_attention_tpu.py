@@ -2,26 +2,10 @@
 
 The test session itself runs on the virtual CPU mesh (tests/conftest.py), so
 the hardware check runs in a child process with the default backend; it is
-skipped when the machine has no TPU.
-"""
+skipped when the machine has no TPU."""
 
-import os
-import subprocess
-import sys
-
-import pytest
-
-_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))))
+from tests.unit.common import run_tpu_tool
 
 
 def test_flash_attention_parity_on_tpu():
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "_GRAFT_DRYRUN_CHILD")}
-    proc = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "tools", "flash_parity.py")],
-        env=env, capture_output=True, text=True, timeout=600)
-    out = proc.stdout + proc.stderr
-    assert proc.returncode == 0, f"flash parity child failed:\n{out}"
-    if "SKIP" in proc.stdout:
-        pytest.skip("no TPU attached")
+    run_tpu_tool("flash_parity.py")

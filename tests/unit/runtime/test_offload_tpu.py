@@ -1,0 +1,11 @@
+"""TPU-hardware ZeRO-Offload check (pinned_host honored end-to-end).
+
+Runs tools/offload_check.py in a child process with the default backend;
+skipped on machines without a TPU (the CPU-mesh offload behavior — warn and
+continue — is covered in test_zero_init.py)."""
+
+from tests.unit.common import run_tpu_tool
+
+
+def test_zero_offload_on_tpu():
+    run_tpu_tool("offload_check.py")
