@@ -1,0 +1,8 @@
+"""Launcher: the ``dst`` CLI and per-node process spawner.
+
+The reference's launcher tree (``deepspeed/launcher/``): ``runner.py``
+(resource parsing + multinode dispatch), ``launch.py`` (per-node fork, env
+wiring, failure propagation), ``multinode_runner.py`` (pdsh/mpi/slurm
+command construction).  Here the per-device fork becomes one process per
+TPU *host* with ``jax.distributed`` coordinator wiring.
+"""

@@ -1,0 +1,138 @@
+"""Per-node launcher (reference ``deepspeed/launcher/launch.py:120``).
+
+Decodes ``--world_info`` (base64 JSON host→slots), determines this node's
+rank, forks one child per local slot with the jax.distributed env wired
+(RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT / LOCAL_RANK), streams
+output, and propagates failures: if any child dies, the whole tree is
+killed and the launcher exits non-zero (reference ``launch.py:106,295``).
+
+On TPU the normal shape is ONE process per host that owns all local chips
+(slots=1); slots>1 supports CPU simulation and subslicing.
+"""
+
+import argparse
+import base64
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(prog="dst-launch")
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 JSON of host → slot count")
+    parser.add_argument("--node_rank", type=int, default=-1,
+                        help="This node's rank; derived from hostname or "
+                             "scheduler env when -1")
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--num_procs", type=int, default=-1,
+                        help="Override processes on this node")
+    parser.add_argument("--enable_each_rank_log", type=str, default="",
+                        help="Directory for per-rank log files")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(encoded: str):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def resolve_node_rank(args, hosts: List[str]) -> int:
+    if args.node_rank >= 0:
+        return args.node_rank
+    for env in ("SLURM_NODEID", "OMPI_COMM_WORLD_RANK", "PMI_RANK", "TPU_WORKER_ID"):
+        if env in os.environ:
+            return int(os.environ[env])
+    hostname = socket.gethostname()
+    for i, h in enumerate(hosts):
+        if h in (hostname, hostname.split(".")[0], "localhost", "127.0.0.1"):
+            return i
+    raise RuntimeError(f"cannot determine node rank: hostname {hostname!r} "
+                       f"not in world {hosts}")
+
+
+def main(args=None):
+    args = parse_args(args)
+    world = decode_world_info(args.world_info)
+    hosts = list(world.keys())
+    node_rank = resolve_node_rank(args, hosts)
+    local_procs = args.num_procs if args.num_procs > 0 else world[hosts[node_rank]]
+    global_rank_offset = sum(
+        (args.num_procs if args.num_procs > 0 else world[h])
+        for h in hosts[:node_rank])
+    world_size = sum((args.num_procs if args.num_procs > 0 else world[h])
+                     for h in hosts)
+
+    log_dir = args.enable_each_rank_log
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    processes = []
+    for local_rank in range(local_procs):
+        rank = global_rank_offset + local_rank
+        env = dict(os.environ)
+        env.update(
+            RANK=str(rank),
+            LOCAL_RANK=str(local_rank),
+            WORLD_SIZE=str(world_size),
+            MASTER_ADDR=args.master_addr,
+            MASTER_PORT=str(args.master_port),
+            # jax.distributed aliases (comm.init_distributed reads either)
+            PROCESS_ID=str(rank),
+            NUM_PROCESSES=str(world_size),
+            COORDINATOR_ADDRESS=f"{args.master_addr}:{args.master_port}",
+        )
+        cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
+        if log_dir:
+            out = open(os.path.join(log_dir, f"rank_{rank}.log"), "w")
+            proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=subprocess.STDOUT)
+        else:
+            proc = subprocess.Popen(cmd, env=env)
+        processes.append(proc)
+        logger.info(f"dst-launch: rank {rank} (local {local_rank}) pid={proc.pid}")
+
+    def kill_all(signum=None, frame=None):
+        for p in processes:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in processes:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    signal.signal(signal.SIGTERM, kill_all)
+    signal.signal(signal.SIGINT, kill_all)
+
+    # monitor: first non-zero exit kills the tree (reference launch.py:295)
+    rc = 0
+    try:
+        while processes:
+            for p in list(processes):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                processes.remove(p)
+                if ret != 0:
+                    logger.error(f"dst-launch: pid {p.pid} exited rc={ret}; "
+                                 f"killing remaining processes")
+                    kill_all()
+                    return ret
+            time.sleep(0.1)
+    finally:
+        kill_all()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,230 @@
+"""``dst`` — the launcher CLI (reference ``deepspeed/launcher/runner.py``).
+
+Responsibilities (reference line cites):
+* resource parsing: hostfile ``host slots=N`` (``runner.py:179-232``),
+  ``--include``/``--exclude`` filters (``:234-324``);
+* TPU pod discovery: one process per host from pod metadata env
+  (``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES``) instead of per-GPU ranks;
+* single-node: exec ``deepspeed_tpu.launcher.launch`` directly
+  (``runner.py:466-484``);
+* multi-node: build a pdsh/mpirun/srun command that re-invokes the per-node
+  launcher on every host (``runner.py:487-498``) — command construction is
+  unit-testable without ssh;
+* ``.deepspeed_env`` propagation (``runner.py:36,514-520``).
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner,
+                                                     PDSHRunner, SlurmRunner)
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHON", "PATH", "LD_LIBRARY", "JAX", "XLA", "TPU", "LIBTPU", "DST"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        prog="dst",
+        description="dst: distributed training launcher for deepspeed_tpu")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Host filter, e.g. 'worker-0@worker-1:0,2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Host exclusion filter, same syntax as --include")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Limit the number of nodes used")
+    parser.add_argument("--num_procs", "--num_gpus", dest="num_procs", type=int, default=-1,
+                        help="Processes per node (default: one per host — the "
+                             "TPU model; all local chips belong to one process)")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="jax.distributed coordinator address")
+    parser.add_argument("--master_port", type=int, default=29500,
+                        help="jax.distributed coordinator port")
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=["pdsh", "openmpi", "mpich", "slurm"],
+                        help="Multi-node transport")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="Extra flags for the multi-node transport")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="Treat as multi-node even for a single host")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="Run the autotuner to discover config values")
+    parser.add_argument("user_script", type=str, help="User training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER,
+                        help="User script arguments")
+    return parser.parse_args(args=args)
+
+
+# --------------------------------------------------------------------------- #
+# Resource discovery
+# --------------------------------------------------------------------------- #
+def fetch_hostfile(hostfile_path: str) -> "OrderedDict[str, int]":
+    """Parse ``host slots=N`` lines (reference ``runner.py:179``)."""
+    if not os.path.isfile(hostfile_path):
+        return OrderedDict()
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as fd:
+        for line in fd:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                host, slots = line.split()
+                key, count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(f"expected slots=<n>, got {slots!r}")
+                resources[host] = int(count)
+            except ValueError as e:
+                raise ValueError(f"Hostfile error: bad line {line!r} "
+                                 f"(want '<host> slots=<n>')") from e
+    return resources
+
+
+def discover_tpu_pod() -> "OrderedDict[str, int]":
+    """TPU pod-slice discovery from runtime env (the launcher-side analogue
+    of GCE metadata): ``TPU_WORKER_HOSTNAMES`` is a comma-separated host
+    list every worker gets.  One slot per host — a JAX TPU process owns all
+    local chips."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if not hostnames:
+        return OrderedDict()
+    return OrderedDict((h.strip(), 1) for h in hostnames.split(",") if h.strip())
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'w0@w1:0,2' → {'w0': None, 'w1': [0, 2]} (None = all slots)."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host.strip()] = [int(s) for s in slots.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resources: "OrderedDict[str, int]",
+                              include: str, exclude: str) -> "OrderedDict[str, int]":
+    """Apply --include/--exclude (reference ``runner.py:324``).  Slot-level
+    filters adjust counts; host-level filters drop hosts."""
+    assert not (include and exclude), "--include and --exclude are mutually exclusive"
+    if include:
+        inc = _parse_filter(include)
+        for host in inc:
+            assert host in resources, f"--include host {host!r} not in resources"
+        return OrderedDict(
+            (h, len(inc[h]) if inc[h] is not None else resources[h])
+            for h in resources if h in inc)
+    if exclude:
+        exc = _parse_filter(exclude)
+        out = OrderedDict()
+        for h, n in resources.items():
+            if h not in exc:
+                out[h] = n
+            elif exc[h] is not None:
+                remaining = n - len(exc[h])
+                if remaining > 0:
+                    out[h] = remaining
+        return out
+    return OrderedDict(resources)
+
+
+def encode_world_info(resources: "OrderedDict[str, int]") -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(dict(resources)).encode()).decode()
+
+
+def collect_env_exports(cwd: str = ".") -> Dict[str, str]:
+    """Env vars to propagate to remote nodes: the EXPORT_ENVS prefixes plus
+    anything listed in a ``.deepspeed_env`` file (reference ``runner.py:36``)."""
+    exports = {}
+    for key, val in os.environ.items():
+        if any(key.startswith(p) for p in EXPORT_ENVS):
+            exports[key] = val
+    env_file = os.path.join(cwd, DEEPSPEED_ENVIRONMENT_NAME)
+    if not os.path.isfile(env_file):
+        env_file = os.path.join(os.path.expanduser("~"), DEEPSPEED_ENVIRONMENT_NAME)
+    if os.path.isfile(env_file):
+        with open(env_file) as fd:
+            for line in fd:
+                line = line.strip()
+                if line and not line.startswith("#") and "=" in line:
+                    k, v = line.split("=", 1)
+                    exports[k.strip()] = v.strip()
+    return exports
+
+
+# --------------------------------------------------------------------------- #
+def build_launch_cmd(args, resources: "OrderedDict[str, int]") -> List[str]:
+    """The single-node command: python -m deepspeed_tpu.launcher.launch ...
+    (reference ``runner.py:466-484``)."""
+    world_info = encode_world_info(resources)
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           f"--world_info={world_info}",
+           f"--master_addr={args.master_addr or '127.0.0.1'}",
+           f"--master_port={args.master_port}"]
+    if args.num_procs > 0:
+        cmd.append(f"--num_procs={args.num_procs}")
+    cmd.append(args.user_script)
+    cmd.extend(args.user_args)
+    return cmd
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    if args.autotuning:
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+        tuner = Autotuner(args)
+        best = tuner.tune()
+        if args.autotuning == "tune":
+            logger.info(f"autotuning done; best config: {best}")
+            return 0
+        # 'run': fall through and launch with the tuned config env
+        os.environ["DST_AUTOTUNED_CONFIG"] = json.dumps(best)
+
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        resources = discover_tpu_pod()
+    if not resources:
+        resources = OrderedDict({"localhost": 1})
+    resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    assert resources, "no usable hosts after include/exclude filtering"
+    if args.num_nodes > 0:
+        resources = OrderedDict(list(resources.items())[:args.num_nodes])
+
+    multi_node = args.force_multi or len(resources) > 1
+    if not multi_node:
+        cmd = build_launch_cmd(args, resources)
+        logger.info(f"dst single-node: {' '.join(map(shlex.quote, cmd))}")
+        result = subprocess.run(cmd)
+        return result.returncode
+
+    runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                  "mpich": MPICHRunner, "slurm": SlurmRunner}[args.launcher]
+    runner = runner_cls(args, resources)
+    exports = collect_env_exports()
+    cmd = runner.get_cmd(exports, resources)
+    logger.info(f"dst multi-node ({args.launcher}): "
+                f"{' '.join(map(shlex.quote, cmd))}")
+    result = subprocess.run(cmd)
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""End-to-end per-node launcher behavior with real local child processes:
+env wiring per rank and failure propagation (reference ``launch.py:106,295``
+semantics, validated the way ``tests/unit/launcher/test_run.py`` does)."""
+
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+
+from deepspeed_tpu.launcher import runner as runner_mod
+
+_LAUNCH = [sys.executable, "-m", "deepspeed_tpu.launcher.launch"]
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.getcwd()] + env.get("PYTHONPATH", "").split(os.pathsep))
+    return env
+
+
+def test_launch_sets_rank_env(tmp_path):
+    script = tmp_path / "show_env.py"
+    out = tmp_path / "out"
+    out.mkdir()
+    script.write_text(
+        "import os, json, sys\n"
+        "rank = os.environ['RANK']\n"
+        "open(os.path.join(sys.argv[1], f'r{rank}.json'), 'w').write(json.dumps(\n"
+        "    {k: os.environ[k] for k in ('RANK','LOCAL_RANK','WORLD_SIZE',\n"
+        "     'MASTER_ADDR','MASTER_PORT','COORDINATOR_ADDRESS')}))\n")
+    world = runner_mod.encode_world_info(OrderedDict([("localhost", 2)]))
+    rc = subprocess.run(_LAUNCH + [f"--world_info={world}", "--master_port=29512",
+                                   str(script), str(out)],
+                        env=_env(), timeout=60).returncode
+    assert rc == 0
+    envs = {}
+    for i in range(2):
+        envs[i] = json.loads((out / f"r{i}.json").read_text())
+    assert envs[0]["WORLD_SIZE"] == "2"
+    assert envs[1]["RANK"] == "1" and envs[1]["LOCAL_RANK"] == "1"
+    assert envs[0]["COORDINATOR_ADDRESS"] == "127.0.0.1:29512"
+
+
+def test_launch_propagates_child_failure(tmp_path):
+    script = tmp_path / "fail_one.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['RANK'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(30)\n")  # rank 0 would hang forever if not killed
+    world = runner_mod.encode_world_info(OrderedDict([("localhost", 2)]))
+    proc = subprocess.run(_LAUNCH + [f"--world_info={world}", str(script)],
+                          env=_env(), timeout=60)
+    assert proc.returncode == 3

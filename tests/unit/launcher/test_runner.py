@@ -1,0 +1,107 @@
+"""Launcher unit tests: resource parsing, filters, and multinode command
+construction — no real ssh/mpi, the pattern of the reference's
+``tests/unit/launcher/test_multinode_runner.py`` / ``test_run.py``."""
+
+import base64
+import json
+import os
+from collections import OrderedDict
+
+import pytest
+
+from deepspeed_tpu.launcher import runner as runner_mod
+from deepspeed_tpu.launcher.launch import decode_world_info, resolve_node_rank
+from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner,
+                                                     PDSHRunner, SlurmRunner)
+
+
+def write_hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = write_hostfile(tmp_path, "worker-0 slots=4\nworker-1 slots=2\n# comment\n")
+    res = runner_mod.fetch_hostfile(path)
+    assert res == OrderedDict([("worker-0", 4), ("worker-1", 2)])
+
+
+def test_fetch_hostfile_bad_line(tmp_path):
+    path = write_hostfile(tmp_path, "worker-0 gpus=4\n")
+    with pytest.raises(ValueError):
+        runner_mod.fetch_hostfile(path)
+
+
+def test_missing_hostfile_empty():
+    assert runner_mod.fetch_hostfile("/nonexistent/hostfile") == OrderedDict()
+
+
+def test_include_filter():
+    res = OrderedDict([("w0", 4), ("w1", 4), ("w2", 4)])
+    out = runner_mod.parse_inclusion_exclusion(res, "w0@w1:0,2", "")
+    assert out == OrderedDict([("w0", 4), ("w1", 2)])
+
+
+def test_exclude_filter():
+    res = OrderedDict([("w0", 4), ("w1", 4)])
+    out = runner_mod.parse_inclusion_exclusion(res, "", "w1")
+    assert out == OrderedDict([("w0", 4)])
+    out = runner_mod.parse_inclusion_exclusion(res, "", "w1:0")
+    assert out == OrderedDict([("w0", 4), ("w1", 3)])
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(AssertionError):
+        runner_mod.parse_inclusion_exclusion(OrderedDict(a=1), "a", "a")
+
+
+def test_tpu_pod_discovery(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1k-0,t1k-1,t1k-2")
+    assert runner_mod.discover_tpu_pod() == OrderedDict(
+        [("t1k-0", 1), ("t1k-1", 1), ("t1k-2", 1)])
+
+
+def test_world_info_roundtrip():
+    res = OrderedDict([("w0", 2), ("w1", 1)])
+    assert decode_world_info(runner_mod.encode_world_info(res)) == dict(res)
+
+
+def _args(extra=None):
+    return runner_mod.parse_args((extra or []) + ["train.py", "--lr", "0.1"])
+
+
+def test_single_node_launch_cmd():
+    args = _args(["--master_port", "29501"])
+    cmd = runner_mod.build_launch_cmd(args, OrderedDict([("localhost", 2)]))
+    joined = " ".join(cmd)
+    assert "deepspeed_tpu.launcher.launch" in joined
+    assert "--master_port=29501" in joined
+    assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+
+@pytest.mark.parametrize("cls,binary", [(PDSHRunner, "pdsh"), (OpenMPIRunner, "mpirun"),
+                                        (MPICHRunner, "mpiexec"), (SlurmRunner, "srun")])
+def test_multinode_cmd_construction(cls, binary):
+    args = _args(["--launcher_args", "--tune x"])
+    res = OrderedDict([("w0", 1), ("w1", 1)])
+    cmd = cls(args, res).get_cmd({"JAX_FLAG": "1"}, res)
+    assert cmd[0] == binary
+    joined = " ".join(cmd)
+    assert "deepspeed_tpu.launcher.launch" in joined
+    assert "train.py" in joined
+    assert "JAX_FLAG" in joined
+    assert "--tune" in cmd or "--tune x" in joined
+
+
+def test_resolve_node_rank_env(monkeypatch):
+    monkeypatch.setenv("SLURM_NODEID", "1")
+    args = type("A", (), {"node_rank": -1})
+    assert resolve_node_rank(args, ["a", "b"]) == 1
+
+
+def test_resolve_node_rank_localhost(monkeypatch):
+    for env in ("SLURM_NODEID", "OMPI_COMM_WORLD_RANK", "PMI_RANK", "TPU_WORKER_ID"):
+        monkeypatch.delenv(env, raising=False)
+    args = type("A", (), {"node_rank": -1})
+    assert resolve_node_rank(args, ["localhost"]) == 0
