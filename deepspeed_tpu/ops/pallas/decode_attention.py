@@ -1,0 +1,153 @@
+"""Pallas TPU decode attention with KV cache (inference fast path).
+
+The reference's decode hot loop is the fused ``softmax_context`` CUDA kernel
+(``csrc/transformer/inference/csrc/pt_binding.cpp:1717-1781``) reading a
+KV-cache workspace (``inference_context.h``).  Round 1 shipped a plain-jnp
+full-cache attention that reads all ``max_len`` positions every step; this
+kernel reads ONLY the ``pos + S_q`` valid positions:
+
+* ``pos`` arrives via scalar prefetch, and the kernel loop has a
+  *data-dependent* trip count ``ceil((pos+S_q)/bk)`` — invalid cache blocks
+  are neither DMA'd nor computed (decode is HBM-bound; at pos ≪ max_len
+  this is the whole win).
+* K/V stay in HBM (``MemorySpace.ANY``); each valid block is staged into a
+  VMEM scratch buffer with an explicit ``make_async_copy`` keyed by the
+  dynamic block index.
+* Online softmax in fp32 registers, exactly like the training flash kernel.
+
+Layouts: q ``[B, S_q, H, D]`` (S_q = 1 for decode, small for chunked
+prefill), cache ``[B, T, H, D]``.  Tested against the jnp reference via the
+interpreter on CPU and on hardware by ``tools/decode_bench.py``.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
+def _decode_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
+                   sem_k, sem_v, *, scale, bk, Sq):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    pos = pos_ref[0]
+    q = q_ref[0, 0].astype(jnp.float32)          # [Sq, D]
+    D = q.shape[-1]
+    nk = (pos + Sq + bk - 1) // bk               # data-dependent trip count
+
+    def body(j, carry):
+        m, l, acc = carry
+        cp_k = pltpu.make_async_copy(k_hbm.at[b, pl.ds(j * bk, bk), h, :], k_buf, sem_k)
+        cp_v = pltpu.make_async_copy(v_hbm.at[b, pl.ds(j * bk, bk), h, :], v_buf, sem_v)
+        cp_k.start()
+        cp_v.start()
+        cp_k.wait()
+        cp_v.wait()
+        k = k_buf[...].astype(jnp.float32)       # [bk, D]
+        v = v_buf[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [Sq, bk]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 0)      # query offset
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 1)
+        s = jnp.where(cols <= pos + rows, s, NEG_INF)                # causal vs cache
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                                preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((Sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Sq, 1), jnp.float32)
+    a0 = jnp.zeros((Sq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _decode_call(q, ck, cv, pos, *, bk):
+    """q [B,Sq,H,D], cache [B,T,H,D], pos scalar → out [B,Sq,H,D]."""
+    B, Sq, H, D = q.shape
+    T = ck.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    qt = q.transpose(0, 2, 1, 3)                 # [B,H,Sq,D]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((1, 1, Sq, D), lambda b, h, pos_ref: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Sq, D), lambda b, h, pos_ref: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), ck.dtype),
+            pltpu.VMEM((bk, D), cv.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bk=bk, Sq=Sq),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        interpret=_interpret(),
+    )(jnp.asarray(pos, jnp.int32).reshape(1), qt, ck, cv)
+    return out.transpose(0, 2, 1, 3)
+
+
+def decode_attention_reference(q, ck, cv, pos):
+    """Plain-jnp full-cache decode attention (the round-1 path; kept as the
+    parity reference and the fallback for unsupported shapes/backends)."""
+    B, Sq, H, D = q.shape
+    T = ck.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 1)
+    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 0)
+    s = jnp.where((kpos <= qpos)[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cv)
+
+
+def decode_attention(q, ck, cv, pos, *, block_k: Optional[int] = None):
+    """KV-cache attention for prefill/decode; dispatches to the Pallas
+    kernel when shapes allow, under shard_map when a mesh is active
+    (batch over data/fsdp/expert, heads over tensor — decode never shards
+    the cache length)."""
+    B, Sq, H, D = q.shape
+    T = ck.shape[1]
+    bk = block_k or min(128, T)
+    if T % bk != 0 or D % 8 != 0:
+        return decode_attention_reference(q, ck, cv, pos)
+
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    call = functools.partial(_decode_call, bk=bk)
+    if mesh_lib.has_mesh():
+        mesh = mesh_lib.get_mesh()
+        batch_div = int(np.prod([mesh.shape[a] for a in mesh_lib.BATCH_AXES]))
+        tp = int(mesh.shape["tensor"])
+        if batch_div > 1 or tp > 1:
+            if B % batch_div != 0 or H % tp != 0:
+                return decode_attention_reference(q, ck, cv, pos)
+            qspec = P(mesh_lib.BATCH_AXES, None, "tensor", None)
+            return jax.shard_map(
+                call, mesh=mesh,
+                in_specs=(qspec, qspec, qspec, P()),
+                out_specs=qspec, check_vma=False)(q, ck, cv, pos)
+    return call(q, ck, cv, pos)
