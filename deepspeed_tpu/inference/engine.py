@@ -38,8 +38,18 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
                  params=None, mesh=None, seed: int = 0):
         self._config = config or DeepSpeedInferenceConfig()
-        self.module = model
         self.dtype = self._config.jnp_dtype
+
+        # ---- foreign-model injection (reference :180-204 → module_inject)
+        # an HF torch model is converted to the fused scan decode path;
+        # its weights become the params pytree (TP slicing = sharding).
+        from deepspeed_tpu.module_inject.replace_module import (inject_hf_model,
+                                                                is_hf_model)
+        if is_hf_model(model):
+            model, params = inject_hf_model(model, dtype=self.dtype)
+            log_dist("module_inject: replaced HF model with fused decode path",
+                     ranks=[0])
+        self.module = model
 
         # ---- mesh: inference TP group (reference :261) ----------------- #
         if mesh is None:

@@ -56,6 +56,10 @@ class GPTConfig:
     remat: bool = False
     attn_impl: str = "auto"   # 'auto' | 'flash' | 'reference' | 'ring'
     dtype: Any = jnp.bfloat16
+    # activation of the MLP: 'gelu_tanh' (GPT-2's gelu_new), 'gelu', 'relu'
+    # — lets injected foreign architectures (e.g. OPT) reuse the fused block
+    activation: str = "gelu_tanh"
+    ln_eps: float = 1e-5
     # pad vocab to a multiple (MXU-friendly, and divisible by tensor axis)
     vocab_multiple: int = 128
 
@@ -181,6 +185,16 @@ def gpt_partition_specs(cfg: GPTConfig) -> Dict:
 _constrain = mesh_lib.constrain
 
 
+def _activation(x: Array, kind: str) -> Array:
+    if kind == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if kind == "relu":
+        return jax.nn.relu(x)
+    raise ValueError(f"unknown activation {kind!r}")
+
+
 def layer_norm(x: Array, g: Array, b: Array, eps: float = 1e-5) -> Array:
     # fp32 statistics regardless of activation dtype (bf16-safe)
     xf = x.astype(jnp.float32)
@@ -205,7 +219,7 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
     dt = x.dtype
     r = (jax.random.split(rng, 3) if rng is not None else (None, None, None))
 
-    h = layer_norm(x, p["ln1_g"], p["ln1_b"])
+    h = layer_norm(x, p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)
     qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, H, D)
@@ -221,9 +235,9 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
     x = x + _dropout(o, cfg.dropout, r[0], train)
     x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
 
-    h = layer_norm(x, p["ln2_g"], p["ln2_b"])
+    h = layer_norm(x, p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)
     h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
-    h = jax.nn.gelu(h, approximate=True)
+    h = _activation(h, cfg.activation)
     h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
     x = x + _dropout(h, cfg.dropout, r[1], train)
     return _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
@@ -266,7 +280,7 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
             r = jax.random.fold_in(rng, i) if (rng is not None and train) else None
             x = body(params["blocks"][f"h{i}"], x, r)
 
-    x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
     # tied embedding projection; vocab-parallel → logits sharded over tensor
     logits = (x @ params["wte"].astype(dt).T).astype(jnp.float32)
     return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
@@ -332,7 +346,7 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
 
     def layer(x, layer_in):
         p, ck, cv = layer_in
-        h = layer_norm(x, p["ln1_g"], p["ln1_b"])
+        h = layer_norm(x, p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)
         qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, D)
@@ -343,14 +357,14 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
         o = _cached_attention(q, ck, cv, pos).reshape(B, S, E)
         o = o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
         x = x + o
-        h = layer_norm(x, p["ln2_g"], p["ln2_b"])
+        h = layer_norm(x, p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)
         h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
-        h = jax.nn.gelu(h, approximate=True)
+        h = _activation(h, cfg.activation)
         h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
         return x + h, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["blocks"], cache["k"], cache["v"]))
-    x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
     logits = (x @ params["wte"].astype(dt).T).astype(jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "pos": pos + S}
     return logits, new_cache

@@ -1,0 +1,24 @@
+"""module_inject — foreign-model injection for inference.
+
+Reference: ``deepspeed/module_inject/`` (``replace_module.py:274``,
+``auto_tp.py:13``, ``policy.py:26``).  The reference walks a torch module
+tree and swaps HF transformer blocks for fused CUDA modules; the TPU-native
+equivalent converts a foreign model's *weights* into this framework's fused
+scan layout (one compiled Pallas/XLA decode program) and derives tensor-
+parallel PartitionSpecs for the result:
+
+* :class:`AutoTP` — derives column/row-parallel PartitionSpecs for an
+  arbitrary parameter pytree (the ``tp_parser`` analogue).
+* policies (:mod:`.policies`) — per-architecture weight-layout converters
+  (HF GPT-2, OPT, GPT-Neo) feeding the in-repo fused GPT family.
+* :func:`replace_transformer_layer` / :func:`inject_hf_model` — the
+  ``replace_module.py`` entry points.
+"""
+
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.module_inject.policies import (HFGPT2Policy, HFOPTPolicy,
+                                                  HFGPTNeoPolicy,
+                                                  InjectionPolicy,
+                                                  policy_for_model)
+from deepspeed_tpu.module_inject.replace_module import (inject_hf_model,
+                                                        replace_transformer_layer)

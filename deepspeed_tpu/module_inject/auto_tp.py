@@ -1,0 +1,148 @@
+"""AutoTP — automatic tensor-parallel partition-spec derivation.
+
+Reference: ``deepspeed/module_inject/auto_tp.py:13`` (``AutoTP.tp_parser``),
+which walks a torch module graph to find Linear layers whose outputs feed a
+residual add and marks them row-parallel (slice input dim + all-reduce),
+everything else column-parallel.  On TPU the all-reduce is XLA-SPMD's job;
+what AutoTP must produce is the *sharding metadata*: a PartitionSpec per
+leaf of an arbitrary parameter pytree.
+
+Classification is by leaf path + shape, mirroring the reference's name
+patterns (``auto_tp.py`` ``load_policies``/linear-name heuristics):
+
+* 2-D weights whose path matches a row-parallel pattern (attention output
+  projection, MLP down projection) shard the *input* (contraction) dim.
+* all other 2-D weights shard the *output* dim (column-parallel).
+* embeddings (path matches embed patterns) shard the vocab dim.
+* 1-D vectors shard iff they are the bias of a column-parallel weight
+  (same trailing dim); layer norms stay replicated.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec
+
+# Row-parallel = weight contracted against a TP-sharded activation; the
+# union of the reference's per-arch ``policy.py`` attention-output / MLP-down
+# names plus this repo's fused layout.
+ROW_PARALLEL_PATTERNS = (
+    r"out_w$", r"proj_w$",                      # in-repo fused GPT layout
+    r"attn[./]c_proj", r"mlp[./]c_proj",        # HF GPT-2
+    r"out_proj", r"o_proj", r"dense(\.|/|$)",   # OPT / LLaMA-style / BERT-out
+    r"fc2", r"down_proj", r"dense_4h_to_h", r"w2$",
+)
+EMBEDDING_PATTERNS = (r"wte$", r"embed_tokens", r"word_embeddings", r"wte[./]weight")
+REPLICATED_PATTERNS = (r"wpe", r"position_embed", r"ln", r"layernorm", r"layer_norm",
+                       r"norm(\.|/|$)")
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _matches(name: str, patterns) -> bool:
+    low = name.lower()
+    return any(re.search(p, low) for p in patterns)
+
+
+class AutoTP:
+    """Derive tensor-parallel PartitionSpecs for an arbitrary param pytree.
+
+    ``stacked_first_dim=True`` treats the leading dim of >=3-D leaves as a
+    scan-stacked layer dim (left unsharded by TP; ZeRO composes ``fsdp``
+    there).
+    """
+
+    def __init__(self, mp_size: int = 1, axis: str = "tensor",
+                 stacked_first_dim: bool = True):
+        self.mp_size = mp_size
+        self.axis = axis
+        self.stacked_first_dim = stacked_first_dim
+
+    # -- the tp_parser analogue ----------------------------------------- #
+    def classify(self, name: str, shape: Tuple[int, ...]) -> str:
+        """Return one of 'row' | 'column' | 'embedding' | 'replicated'."""
+        if _matches(name, EMBEDDING_PATTERNS):
+            return "embedding"
+        if len(shape) < 1:
+            return "replicated"
+        if _matches(name, REPLICATED_PATTERNS):
+            return "replicated"
+        core = shape[1:] if (self.stacked_first_dim and len(shape) >= 3) else shape
+        if len(core) == 2:
+            return "row" if _matches(name, ROW_PARALLEL_PATTERNS) else "column"
+        return "replicated"  # 1-D handled in a second pass (bias linking)
+
+    def _spec_for(self, kind: str, shape: Tuple[int, ...]) -> PartitionSpec:
+        pre = (None,) if (self.stacked_first_dim and len(shape) >= 3) else ()
+        ax = self.axis
+        if kind == "embedding":
+            return PartitionSpec(*pre, ax, None) if len(shape) - len(pre) == 2 \
+                else PartitionSpec()
+        if kind == "row":
+            return PartitionSpec(*pre, ax, None)
+        if kind == "column":
+            return PartitionSpec(*pre, None, ax)
+        return PartitionSpec()
+
+    def partition_specs(self, params) -> Any:
+        """PartitionSpec pytree matching ``params``.
+
+        Biases are sharded iff a sibling column-parallel weight has the
+        same output dim (the reference shards column-parallel biases and
+        replicates row-parallel ones, ``replace_module.py``
+        ``ReplaceWithTensorSlicing.copy``).
+        """
+        leaves = jax.tree_util.tree_leaves_with_path(params)
+        info = {}
+        for path, leaf in leaves:
+            name = _path_str(path)
+            shape = tuple(np.shape(leaf))
+            info[name] = (path, shape, self.classify(name, shape))
+
+        # bias linking: find column-parallel output dims per prefix
+        col_dims: Dict[str, set] = {}
+        for name, (_, shape, kind) in info.items():
+            if kind == "column":
+                prefix = name.rsplit("/", 1)[0]
+                col_dims.setdefault(prefix, set()).add(shape[-1])
+
+        specs = {}
+        for name, (path, shape, kind) in info.items():
+            core_ndim = len(shape) - (1 if (self.stacked_first_dim and len(shape) >= 3) else 0)
+            if kind == "replicated" and core_ndim == 1 and not _matches(name, REPLICATED_PATTERNS):
+                prefix = name.rsplit("/", 1)[0]
+                if shape[-1] in col_dims.get(prefix, ()):  # column-parallel bias
+                    pre = (None,) if len(shape) >= 2 else ()
+                    specs[name] = PartitionSpec(*pre, self.axis)
+                    continue
+            specs[name] = self._spec_for(kind, shape)
+
+        # rebuild the pytree structure
+        treedef = jax.tree_util.tree_structure(params)
+        ordered = [specs[_path_str(path)] for path, _ in leaves]
+        return jax.tree_util.tree_unflatten(treedef, ordered)
+
+    # -- reference-compat surface --------------------------------------- #
+    @staticmethod
+    def tp_parser(params) -> List[str]:
+        """List the leaf names AutoTP marks row-parallel (the reference
+        returns the linear names needing an all-reduce, ``auto_tp.py:13``)."""
+        atp = AutoTP()
+        out = []
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+            name = _path_str(path)
+            if atp.classify(name, tuple(np.shape(leaf))) == "row":
+                out.append(name)
+        return out

@@ -1,0 +1,217 @@
+"""Per-architecture injection policies.
+
+Reference: ``deepspeed/module_inject/policy.py:26`` (``DSPolicy`` /
+``TransformerPolicy`` ABC) and the per-arch containers under
+``module_inject/containers/`` (gpt2.py, opt.py, gptneo.py, ...).  A
+reference policy tells ``replace_transformer_layer`` where a given HF
+architecture keeps its qkv/attention-out/mlp weights so they can be fused
+and sliced.  Here a policy converts the HF state dict into the in-repo
+fused GPT layout (``models/gpt.py``): stacked ``[n_layer, ...]`` blocks
+with fused ``qkv_w [E, 3E]`` — the layout the single-scan decode program
+and the Pallas kernels consume.
+
+All conversions are pure numpy on host (runs once at injection time).
+"""
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.gpt import GPTConfig
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().cpu().numpy().astype(np.float32)
+    return np.asarray(t, np.float32)
+
+
+def _pad_vocab(wte: np.ndarray, padded: int) -> np.ndarray:
+    v, e = wte.shape
+    if v == padded:
+        return wte
+    out = np.zeros((padded, e), np.float32)
+    out[:v] = wte
+    return out
+
+
+def _stack(blocks) -> Dict[str, np.ndarray]:
+    """[{k: arr}, ...] per layer -> {k: [L, ...]} scan-stacked."""
+    return {k: np.stack([b[k] for b in blocks]) for k in blocks[0]}
+
+
+class InjectionPolicy:
+    """ABC: map an HF model to (GPTConfig, fused param pytree)."""
+
+    #: HF ``config.model_type`` values this policy handles
+    model_types: Tuple[str, ...] = ()
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        return getattr(hf_config, "model_type", None) in cls.model_types
+
+    def build(self, hf_model) -> Tuple[GPTConfig, Dict]:
+        raise NotImplementedError
+
+
+class HFGPT2Policy(InjectionPolicy):
+    """HF GPT-2 (reference ``module_inject/containers/gpt2.py``).
+
+    HF's Conv1D already stores weights ``[in, out]`` — the fused layout —
+    so qkv/fc copy through; only stacking + vocab padding is needed.
+    """
+
+    model_types = ("gpt2",)
+
+    def build(self, hf_model):
+        hc = hf_model.config
+        cfg = GPTConfig(vocab_size=hc.vocab_size, n_positions=hc.n_positions,
+                        n_embd=hc.n_embd, n_layer=hc.n_layer, n_head=hc.n_head,
+                        activation="gelu_tanh", ln_eps=hc.layer_norm_epsilon)
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        pre = "transformer."
+        blocks = []
+        for i in range(cfg.n_layer):
+            b = f"{pre}h.{i}."
+            blocks.append({
+                "ln1_g": sd[b + "ln_1.weight"], "ln1_b": sd[b + "ln_1.bias"],
+                "qkv_w": sd[b + "attn.c_attn.weight"],
+                "qkv_b": sd[b + "attn.c_attn.bias"],
+                "out_w": sd[b + "attn.c_proj.weight"],
+                "out_b": sd[b + "attn.c_proj.bias"],
+                "ln2_g": sd[b + "ln_2.weight"], "ln2_b": sd[b + "ln_2.bias"],
+                "fc_w": sd[b + "mlp.c_fc.weight"], "fc_b": sd[b + "mlp.c_fc.bias"],
+                "proj_w": sd[b + "mlp.c_proj.weight"],
+                "proj_b": sd[b + "mlp.c_proj.bias"],
+            })
+        params = {
+            "wte": _pad_vocab(sd[pre + "wte.weight"], cfg.padded_vocab),
+            "wpe": sd[pre + "wpe.weight"],
+            "blocks": _stack(blocks),
+            "lnf_g": sd[pre + "ln_f.weight"],
+            "lnf_b": sd[pre + "ln_f.bias"],
+        }
+        return cfg, params
+
+
+class HFOPTPolicy(InjectionPolicy):
+    """HF OPT (reference ``module_inject/containers/opt.py``).
+
+    torch ``nn.Linear`` stores ``[out, in]`` → transpose; separate q/k/v
+    are fused into ``qkv_w``; positional embeddings drop OPT's offset-2
+    rows; per-layer ``final_layer_norm`` is the pre-MLP norm (ln2).
+    """
+
+    model_types = ("opt",)
+
+    def build(self, hf_model):
+        hc = hf_model.config
+        assert getattr(hc, "do_layer_norm_before", True), \
+            "post-LN OPT (350m) layout is not supported by the fused block"
+        assert hc.word_embed_proj_dim == hc.hidden_size, \
+            "OPT word_embed_proj_dim != hidden_size not supported"
+        act = {"relu": "relu", "gelu": "gelu", "gelu_new": "gelu_tanh"}[
+            hc.activation_function]
+        cfg = GPTConfig(vocab_size=hc.vocab_size,
+                        n_positions=hc.max_position_embeddings,
+                        n_embd=hc.hidden_size, n_layer=hc.num_hidden_layers,
+                        n_head=hc.num_attention_heads, activation=act)
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        pre = "model.decoder."
+        blocks = []
+        for i in range(cfg.n_layer):
+            b = f"{pre}layers.{i}."
+            qkv_w = np.concatenate(
+                [sd[b + f"self_attn.{n}_proj.weight"].T for n in ("q", "k", "v")],
+                axis=1)
+            qkv_b = np.concatenate(
+                [sd[b + f"self_attn.{n}_proj.bias"] for n in ("q", "k", "v")])
+            blocks.append({
+                "ln1_g": sd[b + "self_attn_layer_norm.weight"],
+                "ln1_b": sd[b + "self_attn_layer_norm.bias"],
+                "qkv_w": qkv_w, "qkv_b": qkv_b,
+                "out_w": sd[b + "self_attn.out_proj.weight"].T,
+                "out_b": sd[b + "self_attn.out_proj.bias"],
+                "ln2_g": sd[b + "final_layer_norm.weight"],
+                "ln2_b": sd[b + "final_layer_norm.bias"],
+                "fc_w": sd[b + "fc1.weight"].T, "fc_b": sd[b + "fc1.bias"],
+                "proj_w": sd[b + "fc2.weight"].T, "proj_b": sd[b + "fc2.bias"],
+            })
+        params = {
+            "wte": _pad_vocab(sd[pre + "embed_tokens.weight"], cfg.padded_vocab),
+            # OPT's learned positions carry a +2 offset (pad/bos rows)
+            "wpe": sd[pre + "embed_positions.weight"][2:],
+            "blocks": _stack(blocks),
+            "lnf_g": sd[pre + "final_layer_norm.weight"],
+            "lnf_b": sd[pre + "final_layer_norm.bias"],
+        }
+        return cfg, params
+
+
+class HFGPTNeoPolicy(InjectionPolicy):
+    """HF GPT-Neo (reference ``module_inject/containers/gptneo.py``).
+
+    q/k/v/out are bias-free separate Linears; GPT-Neo attention is
+    UNSCALED (no 1/sqrt(d)) — folded in by pre-multiplying the q weights
+    by sqrt(head_dim) so the shared scaled-attention kernel reproduces it.
+    Only all-'global' attention configs are supported (local windowing
+    would need the block-sparse attention op).
+    """
+
+    model_types = ("gpt_neo",)
+
+    def build(self, hf_model):
+        hc = hf_model.config
+        attn_types = [a for a in getattr(hc, "attention_layers", [])]
+        assert all(a == "global" for a in attn_types), (
+            "GPT-Neo local attention layers not supported by dense injection; "
+            "use the sparse-attention ops")
+        cfg = GPTConfig(vocab_size=hc.vocab_size,
+                        n_positions=hc.max_position_embeddings,
+                        n_embd=hc.hidden_size, n_layer=hc.num_layers,
+                        n_head=hc.num_heads, activation="gelu_tanh",
+                        ln_eps=hc.layer_norm_epsilon)
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        pre = "transformer."
+        E = cfg.n_embd
+        scale = math.sqrt(cfg.head_dim)
+        blocks = []
+        for i in range(cfg.n_layer):
+            b = f"{pre}h.{i}."
+            a = b + "attn.attention."
+            qw = sd[a + "q_proj.weight"].T * scale
+            kw = sd[a + "k_proj.weight"].T
+            vw = sd[a + "v_proj.weight"].T
+            blocks.append({
+                "ln1_g": sd[b + "ln_1.weight"], "ln1_b": sd[b + "ln_1.bias"],
+                "qkv_w": np.concatenate([qw, kw, vw], axis=1),
+                "qkv_b": np.zeros((3 * E,), np.float32),
+                "out_w": sd[a + "out_proj.weight"].T,
+                "out_b": sd[a + "out_proj.bias"],
+                "ln2_g": sd[b + "ln_2.weight"], "ln2_b": sd[b + "ln_2.bias"],
+                "fc_w": sd[b + "mlp.c_fc.weight"].T, "fc_b": sd[b + "mlp.c_fc.bias"],
+                "proj_w": sd[b + "mlp.c_proj.weight"].T,
+                "proj_b": sd[b + "mlp.c_proj.bias"],
+            })
+        params = {
+            "wte": _pad_vocab(sd[pre + "wte.weight"], cfg.padded_vocab),
+            "wpe": sd[pre + "wpe.weight"],
+            "blocks": _stack(blocks),
+            "lnf_g": sd[pre + "ln_f.weight"],
+            "lnf_b": sd[pre + "ln_f.bias"],
+        }
+        return cfg, params
+
+
+_POLICIES = (HFGPT2Policy, HFOPTPolicy, HFGPTNeoPolicy)
+
+
+def policy_for_model(hf_model) -> Optional[InjectionPolicy]:
+    """Pick the policy for an HF model (reference
+    ``replace_module.py`` ``generic_policies`` lookup)."""
+    hf_config = getattr(hf_model, "config", None)
+    for pol in _POLICIES:
+        if hf_config is not None and pol.matches(hf_config):
+            return pol()
+    return None

@@ -1,0 +1,53 @@
+"""replace_module — swap a foreign model for the fused TPU decode path.
+
+Reference: ``deepspeed/module_inject/replace_module.py:274``
+(``replace_transformer_layer``): walks the torch module tree replacing HF
+blocks with ``DeepSpeedTransformerInference`` modules whose weights are
+TP-sliced by ``ReplaceWithTensorSlicing``.  TPU-native version: the whole
+model is replaced at once by the in-repo fused GPT implementation (one
+``lax.scan`` decode program over stacked layers — the
+``model_implementations/transformers/ds_transformer.py`` analogue), with
+TP expressed as PartitionSpecs instead of sliced copies; XLA-SPMD slices
+the weights when they are device_put.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+from deepspeed_tpu.models.gpt import GPT
+from deepspeed_tpu.module_inject.policies import (InjectionPolicy,
+                                                  policy_for_model)
+
+
+def inject_hf_model(hf_model, policy: Optional[InjectionPolicy] = None,
+                    dtype=None) -> Tuple[GPT, Dict]:
+    """Convert an HF causal-LM into ``(fused GPT model, params)``.
+
+    The returned model implements the InferenceEngine decode protocol
+    (``init_cache`` / ``apply_with_cache`` / ``generate``), so
+    ``init_inference(hf_model)`` serves it with the single-program scan
+    decode path and the Pallas decode-attention kernel.
+    """
+    policy = policy or policy_for_model(hf_model)
+    if policy is None:
+        mt = getattr(getattr(hf_model, "config", None), "model_type", None)
+        raise ValueError(
+            f"no injection policy for model_type={mt!r}; supported: gpt2, "
+            f"opt, gpt_neo — pass policy= for a custom architecture")
+    cfg, params = policy.build(hf_model)
+    if dtype is not None:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    return GPT(cfg), params
+
+
+def replace_transformer_layer(model, checkpoint=None, policy=None, dtype=None):
+    """Reference-named entry point (``replace_module.py:274``).  Returns the
+    fused replacement model + params; ``checkpoint`` is unused (weights come
+    from the live model — the TPU path has no meta-tensor load)."""
+    return inject_hf_model(model, policy=policy, dtype=dtype)
+
+
+def is_hf_model(model) -> bool:
+    """Duck-typed HF detection (has .config.model_type and .state_dict)."""
+    return (hasattr(model, "state_dict")
+            and hasattr(getattr(model, "config", None), "model_type"))
