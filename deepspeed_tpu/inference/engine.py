@@ -36,17 +36,22 @@ from deepspeed_tpu.utils.logging import log_dist
 class InferenceEngine:
 
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
-                 params=None, mesh=None, seed: int = 0):
+                 params=None, mesh=None, seed: int = 0, policy=None):
         self._config = config or DeepSpeedInferenceConfig()
         self.dtype = self._config.jnp_dtype
 
         # ---- foreign-model injection (reference :180-204 → module_inject)
         # an HF torch model is converted to the fused scan decode path;
         # its weights become the params pytree (TP slicing = sharding).
+        # ``policy`` is the custom-architecture escape hatch (reference
+        # ``injection_policy`` kwarg); caller-supplied ``params`` win over
+        # the weights derived from the HF state dict.
         from deepspeed_tpu.module_inject.replace_module import (inject_hf_model,
                                                                 is_hf_model)
         if is_hf_model(model):
-            model, params = inject_hf_model(model, dtype=self.dtype)
+            model, injected = inject_hf_model(model, policy=policy,
+                                              dtype=self.dtype)
+            params = injected if params is None else params
             log_dist("module_inject: replaced HF model with fused decode path",
                      ranks=[0])
         self.module = model
@@ -148,5 +153,7 @@ def init_inference(model=None, config=None, **kwargs):
     cfg_dict.update(kwargs)
     mesh = cfg_dict.pop("mesh", None)
     params = cfg_dict.pop("params", None)
+    policy = cfg_dict.pop("injection_policy", cfg_dict.pop("policy", None))
     ds_config = DeepSpeedInferenceConfig(**cfg_dict)
-    return InferenceEngine(model, config=ds_config, params=params, mesh=mesh)
+    return InferenceEngine(model, config=ds_config, params=params, mesh=mesh,
+                           policy=policy)

@@ -60,6 +60,9 @@ class GPTConfig:
     # — lets injected foreign architectures (e.g. OPT) reuse the fused block
     activation: str = "gelu_tanh"
     ln_eps: float = 1e-5
+    # separate lm_head matrix (HF tie_word_embeddings=False checkpoints);
+    # params then carry an extra "lm_head" [padded_vocab, n_embd] leaf
+    untied_head: bool = False
     # pad vocab to a multiple (MXU-friendly, and divisible by tensor axis)
     vocab_multiple: int = 128
 
@@ -134,13 +137,17 @@ def init_gpt_params(cfg: GPTConfig, rng: Array) -> Dict:
         blocks = {f"h{i}": _init_block(cfg, k)
                   for i, k in enumerate(jax.random.split(k_blocks, L))}
     embed = _init_embed(cfg, k_embed)
-    return {
+    params = {
         "wte": embed["wte"],
         "wpe": embed["wpe"],
         "blocks": blocks,
         "lnf_g": jnp.ones((E,), jnp.float32),
         "lnf_b": jnp.zeros((E,), jnp.float32),
     }
+    if cfg.untied_head:
+        params["lm_head"] = _dense_init(
+            jax.random.fold_in(k_embed, 2), E, (cfg.padded_vocab, E))
+    return params
 
 
 _BLOCK_SPECS = {
@@ -170,13 +177,16 @@ def gpt_partition_specs(cfg: GPTConfig) -> Dict:
         blocks = block_specs(True)
     else:
         blocks = {f"h{i}": block_specs(False) for i in range(cfg.n_layer)}
-    return {
+    specs = {
         "wte": PartitionSpec("tensor", None),   # vocab-parallel embedding
         "wpe": PartitionSpec(),
         "blocks": blocks,
         "lnf_g": PartitionSpec(),
         "lnf_b": PartitionSpec(),
     }
+    if cfg.untied_head:
+        specs["lm_head"] = PartitionSpec("tensor", None)
+    return specs
 
 
 # --------------------------------------------------------------------------- #
@@ -281,8 +291,10 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
             x = body(params["blocks"][f"h{i}"], x, r)
 
     x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
-    # tied embedding projection; vocab-parallel → logits sharded over tensor
-    logits = (x @ params["wte"].astype(dt).T).astype(jnp.float32)
+    # tied embedding projection (or the untied lm_head when the source
+    # checkpoint has one); vocab-parallel → logits sharded over tensor
+    head = params["lm_head"] if cfg.untied_head else params["wte"]
+    logits = (x @ head.astype(dt).T).astype(jnp.float32)
     return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
 
 
@@ -365,7 +377,8 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["blocks"], cache["k"], cache["v"]))
     x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
-    logits = (x @ params["wte"].astype(dt).T).astype(jnp.float32)
+    head = params["lm_head"] if cfg.untied_head else params["wte"]
+    logits = (x @ head.astype(dt).T).astype(jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "pos": pos + S}
     return logits, new_cache
 

@@ -37,6 +37,9 @@ ROW_PARALLEL_PATTERNS = (
 EMBEDDING_PATTERNS = (r"wte$", r"embed_tokens", r"word_embeddings", r"wte[./]weight")
 REPLICATED_PATTERNS = (r"wpe", r"position_embed", r"ln", r"layernorm", r"layer_norm",
                        r"norm(\.|/|$)")
+# biases are named, not shape-inferred: a scan-stacked bias is 2-D ([L, dim])
+# and would otherwise be mistaken for a weight matrix
+BIAS_PATTERNS = (r"_b$", r"[./]b$", r"bias$")
 
 
 def _path_str(path) -> str:
@@ -72,28 +75,41 @@ class AutoTP:
 
     # -- the tp_parser analogue ----------------------------------------- #
     def classify(self, name: str, shape: Tuple[int, ...]) -> str:
-        """Return one of 'row' | 'column' | 'embedding' | 'replicated'."""
+        """Return one of 'row' | 'column' | 'embedding' | 'bias' | 'replicated'."""
         if _matches(name, EMBEDDING_PATTERNS):
             return "embedding"
         if len(shape) < 1:
             return "replicated"
         if _matches(name, REPLICATED_PATTERNS):
             return "replicated"
+        if _matches(name, BIAS_PATTERNS):
+            return "bias"      # linked to its weight in a second pass
         core = shape[1:] if (self.stacked_first_dim and len(shape) >= 3) else shape
         if len(core) == 2:
             return "row" if _matches(name, ROW_PARALLEL_PATTERNS) else "column"
-        return "replicated"  # 1-D handled in a second pass (bias linking)
+        return "replicated"  # unnamed 1-D vectors stay replicated
 
-    def _spec_for(self, kind: str, shape: Tuple[int, ...]) -> PartitionSpec:
+    def _check(self, name: str, shape: Tuple[int, ...], dim: int,
+               spec: PartitionSpec) -> PartitionSpec:
+        """Validate the sharded dim divides by mp_size (when declared)."""
+        if self.mp_size > 1 and shape[dim] % self.mp_size != 0:
+            raise ValueError(
+                f"AutoTP: {name} dim {dim} of shape {shape} is not divisible "
+                f"by mp_size {self.mp_size}")
+        return spec
+
+    def _spec_for(self, name: str, kind: str,
+                  shape: Tuple[int, ...]) -> PartitionSpec:
         pre = (None,) if (self.stacked_first_dim and len(shape) >= 3) else ()
         ax = self.axis
         if kind == "embedding":
-            return PartitionSpec(*pre, ax, None) if len(shape) - len(pre) == 2 \
-                else PartitionSpec()
+            if len(shape) - len(pre) != 2:
+                return PartitionSpec()
+            return self._check(name, shape, -2, PartitionSpec(*pre, ax, None))
         if kind == "row":
-            return PartitionSpec(*pre, ax, None)
+            return self._check(name, shape, -2, PartitionSpec(*pre, ax, None))
         if kind == "column":
-            return PartitionSpec(*pre, None, ax)
+            return self._check(name, shape, -1, PartitionSpec(*pre, None, ax))
         return PartitionSpec()
 
     def partition_specs(self, params) -> Any:
@@ -120,14 +136,19 @@ class AutoTP:
 
         specs = {}
         for name, (path, shape, kind) in info.items():
-            core_ndim = len(shape) - (1 if (self.stacked_first_dim and len(shape) >= 3) else 0)
-            if kind == "replicated" and core_ndim == 1 and not _matches(name, REPLICATED_PATTERNS):
+            if kind == "bias":
+                # column-parallel bias shards with its weight's output dim;
+                # row-parallel bias is replicated (added after the implicit
+                # all-reduce, exactly the reference's rule)
                 prefix = name.rsplit("/", 1)[0]
-                if shape[-1] in col_dims.get(prefix, ()):  # column-parallel bias
-                    pre = (None,) if len(shape) >= 2 else ()
-                    specs[name] = PartitionSpec(*pre, self.axis)
-                    continue
-            specs[name] = self._spec_for(kind, shape)
+                if shape[-1] in col_dims.get(prefix, ()):
+                    pre = (None,) * (len(shape) - 1)
+                    specs[name] = self._check(name, shape, -1,
+                                              PartitionSpec(*pre, self.axis))
+                else:
+                    specs[name] = PartitionSpec()
+                continue
+            specs[name] = self._spec_for(name, kind, shape)
 
         # rebuild the pytree structure
         treedef = jax.tree_util.tree_structure(params)

@@ -41,6 +41,30 @@ def _stack(blocks) -> Dict[str, np.ndarray]:
     return {k: np.stack([b[k] for b in blocks]) for k in blocks[0]}
 
 
+_HF_ACTIVATIONS = {"relu": "relu", "gelu": "gelu",
+                   "gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh"}
+
+
+def _map_activation(hf_act: str) -> str:
+    """HF ``activation_function`` → fused-block activation name."""
+    if hf_act not in _HF_ACTIVATIONS:
+        raise NotImplementedError(
+            f"activation {hf_act!r} not supported by the fused block; "
+            f"supported: {sorted(_HF_ACTIVATIONS)}")
+    return _HF_ACTIVATIONS[hf_act]
+
+
+def _untied_head(hf_config, sd: Dict[str, np.ndarray], head_key: str):
+    """The distinct lm_head matrix, or None when tied.
+
+    Tied checkpoints (the HF default) project logits through the input
+    embedding; untied fine-tunes carry a separate lm_head matrix which
+    must be loaded, not silently replaced by wte."""
+    if getattr(hf_config, "tie_word_embeddings", True):
+        return None
+    return sd[head_key]
+
+
 class InjectionPolicy:
     """ABC: map an HF model to (GPTConfig, fused param pytree)."""
 
@@ -66,10 +90,12 @@ class HFGPT2Policy(InjectionPolicy):
 
     def build(self, hf_model):
         hc = hf_model.config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        head = _untied_head(hc, sd, "lm_head.weight")
         cfg = GPTConfig(vocab_size=hc.vocab_size, n_positions=hc.n_positions,
                         n_embd=hc.n_embd, n_layer=hc.n_layer, n_head=hc.n_head,
-                        activation="gelu_tanh", ln_eps=hc.layer_norm_epsilon)
-        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+                        activation=_map_activation(hc.activation_function),
+                        ln_eps=hc.layer_norm_epsilon, untied_head=head is not None)
         pre = "transformer."
         blocks = []
         for i in range(cfg.n_layer):
@@ -92,6 +118,8 @@ class HFGPT2Policy(InjectionPolicy):
             "lnf_g": sd[pre + "ln_f.weight"],
             "lnf_b": sd[pre + "ln_f.bias"],
         }
+        if head is not None:
+            params["lm_head"] = _pad_vocab(head, cfg.padded_vocab)
         return cfg, params
 
 
@@ -111,13 +139,14 @@ class HFOPTPolicy(InjectionPolicy):
             "post-LN OPT (350m) layout is not supported by the fused block"
         assert hc.word_embed_proj_dim == hc.hidden_size, \
             "OPT word_embed_proj_dim != hidden_size not supported"
-        act = {"relu": "relu", "gelu": "gelu", "gelu_new": "gelu_tanh"}[
-            hc.activation_function]
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        head = _untied_head(hc, sd, "lm_head.weight")
         cfg = GPTConfig(vocab_size=hc.vocab_size,
                         n_positions=hc.max_position_embeddings,
                         n_embd=hc.hidden_size, n_layer=hc.num_hidden_layers,
-                        n_head=hc.num_attention_heads, activation=act)
-        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+                        n_head=hc.num_attention_heads,
+                        activation=_map_activation(hc.activation_function),
+                        untied_head=head is not None)
         pre = "model.decoder."
         blocks = []
         for i in range(cfg.n_layer):
@@ -146,6 +175,8 @@ class HFOPTPolicy(InjectionPolicy):
             "lnf_g": sd[pre + "final_layer_norm.weight"],
             "lnf_b": sd[pre + "final_layer_norm.bias"],
         }
+        if head is not None:
+            params["lm_head"] = _pad_vocab(head, cfg.padded_vocab)
         return cfg, params
 
 
@@ -167,12 +198,15 @@ class HFGPTNeoPolicy(InjectionPolicy):
         assert all(a == "global" for a in attn_types), (
             "GPT-Neo local attention layers not supported by dense injection; "
             "use the sparse-attention ops")
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        head = _untied_head(hc, sd, "lm_head.weight")
         cfg = GPTConfig(vocab_size=hc.vocab_size,
                         n_positions=hc.max_position_embeddings,
                         n_embd=hc.hidden_size, n_layer=hc.num_layers,
-                        n_head=hc.num_heads, activation="gelu_tanh",
-                        ln_eps=hc.layer_norm_epsilon)
-        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+                        n_head=hc.num_heads,
+                        activation=_map_activation(hc.activation_function),
+                        ln_eps=hc.layer_norm_epsilon,
+                        untied_head=head is not None)
         pre = "transformer."
         E = cfg.n_embd
         scale = math.sqrt(cfg.head_dim)
@@ -201,6 +235,8 @@ class HFGPTNeoPolicy(InjectionPolicy):
             "lnf_g": sd[pre + "ln_f.weight"],
             "lnf_b": sd[pre + "ln_f.bias"],
         }
+        if head is not None:
+            params["lm_head"] = _pad_vocab(head, cfg.padded_vocab)
         return cfg, params
 
 

@@ -209,6 +209,19 @@ class DeepSpeedConfig:
         self.gradient_predivide_factor = get_scalar_param(pd, C.GRADIENT_PREDIVIDE_FACTOR,
                                                           C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
         self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        # sparse attention block (ref runtime/config.py:get_sparse_attention):
+        # validated eagerly so config typos fail at init, instantiated
+        # per-layer via sparsity_config_from_dict (needs num_heads)
+        self.sparse_attention = pd.get(C.SPARSE_ATTENTION, None)
+        if self.sparse_attention is not None:
+            from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+                validate_sparsity_mode)
+            if not isinstance(self.sparse_attention, dict):
+                raise ValueError(
+                    f"'{C.SPARSE_ATTENTION}' must be a dict, "
+                    f"got {type(self.sparse_attention).__name__}")
+            validate_sparsity_mode(
+                self.sparse_attention.get(C.SPARSE_MODE, C.SPARSE_MODE_DEFAULT))
         self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
 
         # optimizer / scheduler blocks stay dicts (the optimizer factory

@@ -156,3 +156,10 @@ PLD_THETA = "theta"
 PLD_THETA_DEFAULT = 1.0
 PLD_GAMMA = "gamma"
 PLD_GAMMA_DEFAULT = 0.001
+
+#############################################
+# Sparse attention (ref constants.py SPARSE_ATTENTION)
+#############################################
+SPARSE_ATTENTION = "sparse_attention"
+SPARSE_MODE = "mode"
+SPARSE_MODE_DEFAULT = "fixed"

@@ -129,3 +129,74 @@ class TestAutoTP:
         specs = AutoTP().partition_specs(params)
         assert specs["blocks"]["fc_w"] == P(None, None, "tensor")
         assert specs["blocks"]["proj_w"] == P(None, "tensor", None)
+
+
+class TestAutoTPBiasAndValidation:
+
+    def test_stacked_bias_links_to_weight(self):
+        """A scan-stacked bias [L, dim] is a bias, not a 2-D weight: column
+        biases shard on the trailing dim, row biases stay replicated."""
+        from jax.sharding import PartitionSpec as P
+        params = {"blocks": {
+            "qkv_w": np.zeros((4, 16, 48)), "qkv_b": np.zeros((4, 48)),
+            "out_w": np.zeros((4, 16, 16)), "out_b": np.zeros((4, 16)),
+        }}
+        specs = AutoTP().partition_specs(params)
+        assert specs["blocks"]["qkv_b"] == P(None, "tensor")
+        assert specs["blocks"]["out_b"] == P()
+
+    def test_mp_size_divisibility_validated(self):
+        params = {"fc_w": np.zeros((16, 50))}    # 50 % 4 != 0
+        with pytest.raises(ValueError, match="not divisible"):
+            AutoTP(mp_size=4).partition_specs(params)
+
+
+class TestInjectionFixes:
+
+    def test_untied_lm_head_is_loaded(self):
+        """tie_word_embeddings=False checkpoints keep their distinct head."""
+        torch.manual_seed(1)
+        cfg = transformers.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                                      n_layer=2, n_head=4,
+                                      tie_word_embeddings=False)
+        hf = transformers.GPT2LMHeadModel(cfg).eval()
+        # make the head distinct from wte for sure
+        with torch.no_grad():
+            hf.lm_head.weight.add_(torch.randn_like(hf.lm_head.weight))
+        ids = np.array([[5, 11, 2, 7, 3, 1, 0, 9]], np.int64)
+        engine = deepspeed_tpu.init_inference(hf, dtype="fp32")
+        ours = np.asarray(engine.forward(ids), np.float32)[:, :, :97]
+        ref = _hf_logits(hf, ids)
+        np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+    def test_activation_function_respected(self):
+        """activation_function='relu' must not silently become gelu."""
+        torch.manual_seed(2)
+        cfg = transformers.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                                      n_layer=2, n_head=4,
+                                      activation_function="relu")
+        hf = transformers.GPT2LMHeadModel(cfg).eval()
+        ids = np.array([[5, 11, 2, 7]], np.int64)
+        engine = deepspeed_tpu.init_inference(hf, dtype="fp32")
+        ours = np.asarray(engine.forward(ids), np.float32)[:, :, :97]
+        np.testing.assert_allclose(ours, _hf_logits(hf, ids), atol=2e-3, rtol=2e-3)
+
+    def test_unsupported_activation_raises(self):
+        from deepspeed_tpu.module_inject.policies import _map_activation
+        with pytest.raises(NotImplementedError, match="silu"):
+            _map_activation("silu")
+
+    def test_caller_params_not_overwritten(self):
+        """InferenceEngine(hf_model, params=...) honors the caller's params."""
+        torch.manual_seed(3)
+        cfg = transformers.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                                      n_layer=2, n_head=4)
+        hf = transformers.GPT2LMHeadModel(cfg).eval()
+        from deepspeed_tpu.module_inject import inject_hf_model
+        _, params = inject_hf_model(hf)
+        import jax
+        zeroed = jax.tree.map(lambda a: np.zeros_like(a), params)
+        engine = deepspeed_tpu.init_inference(hf, dtype="fp32", params=zeroed)
+        ids = np.array([[5, 11]], np.int64)
+        out = np.asarray(engine.forward(ids), np.float32)
+        assert np.allclose(out, out[0, 0, 0])    # all-zero params → flat logits

@@ -1,0 +1,244 @@
+"""Block-sparse attention: layout builders + Pallas kernel parity.
+
+Mirrors the intent of the reference's
+``tests/unit/ops/sparse_attention/test_sparse_attention.py``: layouts are
+checked structurally, and the kernel is validated against a masked-dense
+reference (here the jnp ``sparse_reference_attention``), forward and
+backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+    block_sparse_attention,
+    sparse_reference_attention,
+)
+
+
+def _qkv(key, B=2, S=256, H=2, D=32, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    shape = (B, S, H, D)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+# --------------------------------------------------------------------------- #
+# Layouts
+# --------------------------------------------------------------------------- #
+class TestLayouts:
+    def test_dense_is_all_ones(self):
+        layout = DenseSparsityConfig(num_heads=3, block=32).make_layout(128)
+        assert layout.shape == (3, 4, 4)
+        assert layout.min() == 1
+
+    def test_block_divisibility_enforced(self):
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(num_heads=2, block=64).make_layout(100)
+
+    def test_fixed_local_windows(self):
+        cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                                  attention="bidirectional")
+        layout = cfg.make_layout(16 * 8)
+        # window-diagonal blocks all present
+        for r in range(8):
+            w = r // 4
+            assert layout[0, r, 4 * w:4 * w + 4].all()
+
+    def test_fixed_unidirectional_is_causal(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=4,
+                                  attention="unidirectional")
+        layout = cfg.make_layout(16 * 8)
+        assert np.array_equal(layout, np.tril(layout))
+
+    def test_fixed_global_column_present(self):
+        cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                                  num_global_blocks=1, attention="bidirectional")
+        layout = cfg.make_layout(16 * 8)
+        # last block of each local window is a global column for all rows
+        assert layout[0, :, 3].all() and layout[0, :, 7].all()
+
+    def test_fixed_different_global_patterns_rotate(self):
+        cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                                  different_layout_per_head=True,
+                                  num_different_global_patterns=4,
+                                  attention="bidirectional")
+        layout = cfg.make_layout(16 * 4)
+        # head h's global column inside the single window is 3-h
+        for h in range(4):
+            assert layout[h, :, 3 - h].all()
+
+    def test_fixed_validation(self):
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(num_heads=2, num_local_blocks=4, num_global_blocks=3)
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(num_heads=2, horizontal_global_attention=True,
+                                attention="unidirectional")
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(num_heads=2, num_different_global_patterns=2)
+
+    def test_variable_windows_and_globals(self):
+        cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                     local_window_blocks=[2, 4],
+                                     global_block_indices=[0],
+                                     attention="bidirectional")
+        layout = cfg.make_layout(16 * 8)
+        assert layout[0, 0, :2].all() and layout[0, 2, 2:6].all()
+        assert layout[0, :, 0].all()          # global column
+        # remaining rows reuse the last window size (4)
+        assert layout[0, 6, 6:8].all()
+
+    def test_variable_unidirectional_never_attends_future(self):
+        cfg = VariableSparsityConfig(num_heads=2, block=16, num_random_blocks=2,
+                                     attention="unidirectional", seed=3)
+        layout = cfg.make_layout(16 * 8)
+        assert np.array_equal(layout, np.tril(layout))
+
+    def test_bigbird_structure(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                    num_sliding_window_blocks=3, num_global_blocks=1,
+                                    attention="bidirectional")
+        layout = cfg.make_layout(16 * 8)
+        assert layout[0, 0, :].all() and layout[0, :, 0].all()   # ITC global
+        for r in range(1, 7):                                     # sliding window
+            assert layout[0, r, r - 1:r + 2].all()
+        assert (layout[0].sum(axis=1) >= 3).all()                 # window+random
+
+    def test_bigbird_seed_determinism(self):
+        mk = lambda: BigBirdSparsityConfig(num_heads=2, block=16, seed=7,
+                                           num_random_blocks=2).make_layout(16 * 8)
+        assert np.array_equal(mk(), mk())
+
+    def test_bslongformer_globals(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         num_sliding_window_blocks=3,
+                                         global_block_indices=[0, 4])
+        layout = cfg.make_layout(16 * 8)
+        assert layout[0, 0, :].all() and layout[0, :, 4].all()
+        assert layout[0, 4, :].all()
+
+    def test_bslongformer_end_indices(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         global_block_indices=[0],
+                                         global_block_end_indices=[2])
+        layout = cfg.make_layout(16 * 8)
+        assert layout[0, :, :2].all()
+
+    def test_local_sliding_window_causal(self):
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=16,
+                                               num_sliding_window_blocks=3,
+                                               attention="unidirectional")
+        layout = cfg.make_layout(16 * 8)
+        assert np.array_equal(layout, np.tril(layout))
+        for r in range(8):
+            lo = max(0, r - 1)
+            assert layout[0, r, lo:r + 1].all()
+            assert layout[0, r].sum() == r + 1 - lo
+
+    def test_propagation_single_layout(self):
+        cfg = BigBirdSparsityConfig(num_heads=4, block=16, seed=1)
+        layout = cfg.make_layout(16 * 8)
+        for h in range(1, 4):
+            assert np.array_equal(layout[h], layout[0])
+
+
+# --------------------------------------------------------------------------- #
+# Kernel parity
+# --------------------------------------------------------------------------- #
+class TestBlockSparseKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, causal):
+        q, k, v = _qkv(jax.random.key(0), B=2, S=256, H=2, D=32)
+        attention = "unidirectional" if causal else "bidirectional"
+        layout = BigBirdSparsityConfig(num_heads=2, block=64, seed=2,
+                                       attention=attention).make_layout(256)
+        out = block_sparse_attention(q, k, v, layout, causal=causal)
+        ref = sparse_reference_attention(q, k, v, layout, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_forward_fixed_pattern(self):
+        q, k, v = _qkv(jax.random.key(1), B=1, S=256, H=2, D=32)
+        layout = FixedSparsityConfig(num_heads=2, block=64, num_local_blocks=2,
+                                     attention="unidirectional").make_layout(256)
+        out = block_sparse_attention(q, k, v, layout, causal=True)
+        ref = sparse_reference_attention(q, k, v, layout, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_reference(self):
+        q, k, v = _qkv(jax.random.key(2), B=1, S=128, H=2, D=32)
+        layout = BSLongformerSparsityConfig(num_heads=2, block=32).make_layout(128)
+
+        def loss_kernel(q, k, v):
+            return jnp.sum(block_sparse_attention(q, k, v, layout) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(sparse_reference_attention(q, k, v, layout) ** 2)
+
+        g1 = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_empty_rows_zero_output_and_grad(self):
+        q, k, v = _qkv(jax.random.key(3), B=1, S=128, H=1, D=32)
+        layout = np.zeros((1, 4, 4), np.int32)
+        layout[0, :2, :2] = 1                     # rows 2-3 attend nothing
+        out = block_sparse_attention(q, k, v, layout)
+        assert np.allclose(out[:, 64:], 0.0)
+        g = jax.grad(lambda q: jnp.sum(block_sparse_attention(q, k, v, layout)))(q)
+        assert np.all(np.isfinite(g))
+        assert np.allclose(g[:, 64:], 0.0)
+
+    def test_sparse_beats_dense_flops(self):
+        # the LUT must actually skip blocks: a half-empty layout touches
+        # half the k-blocks, so summed probabilities over masked cols are 0
+        q, k, v = _qkv(jax.random.key(4), B=1, S=128, H=1, D=32)
+        layout = np.zeros((1, 4, 4), np.int32)
+        layout[0, :, 0] = 1
+        out = block_sparse_attention(q, k, v, layout)
+        ref = sparse_reference_attention(q, k, v, layout)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+# --------------------------------------------------------------------------- #
+# Module
+# --------------------------------------------------------------------------- #
+class TestSparseSelfAttention:
+    def test_module_fast_path(self):
+        attn = SparseSelfAttention(
+            BigBirdSparsityConfig(num_heads=2, block=64, seed=5),
+            max_seq_length=512)
+        q, k, v = _qkv(jax.random.key(5), B=2, S=256, H=2, D=32)
+        out = attn(q, k, v)
+        ref = sparse_reference_attention(q, k, v, attn.get_layout(256))
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_module_mask_path(self):
+        attn = SparseSelfAttention(
+            BigBirdSparsityConfig(num_heads=2, block=32, seed=5),
+            max_seq_length=256, key_padding_mask_mode="mul")
+        q, k, v = _qkv(jax.random.key(6), B=2, S=128, H=2, D=32)
+        kp = np.ones((2, 128), np.float32)
+        kp[:, 96:] = 0                          # mask the tail keys
+        out = attn(q, k, v, key_padding_mask=jnp.asarray(kp))
+        # masked keys must not influence the output
+        v2 = v.at[:, 96:].set(123.0)
+        out2 = attn(q, k, v2, key_padding_mask=jnp.asarray(kp))
+        np.testing.assert_allclose(out, out2, atol=1e-5)
+
+    def test_sub_layout_of_master(self):
+        attn = SparseSelfAttention(
+            FixedSparsityConfig(num_heads=2, block=64), max_seq_length=512)
+        sub = attn.get_layout(256)
+        assert sub.shape == (2, 4, 4)
+        assert np.array_equal(sub, attn.master_layout[:, :4, :4])
+        with pytest.raises(ValueError):
+            attn.get_layout(1024)

@@ -1,0 +1,11 @@
+"""TPU-hardware block-sparse-attention parity (interpret=False).
+
+The test session runs on the virtual CPU mesh (tests/conftest.py), so the
+hardware check runs in a child process with the default backend; it is
+skipped when the machine has no TPU."""
+
+from tests.unit.common import run_tpu_tool
+
+
+def test_block_sparse_attention_parity_on_tpu():
+    run_tpu_tool("sparse_parity.py")

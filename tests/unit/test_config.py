@@ -117,3 +117,24 @@ def test_mesh_config():
     }, world_size=8)
     assert cfg.mesh_config.tensor == 2
     assert cfg.dp_world_size == 4
+
+
+def test_sparse_attention_block_parses():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "sparse_attention": {"mode": "bigbird", "block": 64,
+                                                "num_random_blocks": 2}})
+    assert cfg.sparse_attention["mode"] == "bigbird"
+
+    import pytest
+    with pytest.raises(NotImplementedError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "sparse_attention": {"mode": "nope"}})
+
+
+def test_sparsity_config_factory_rejects_unknown_keys():
+    import pytest
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        sparsity_config_from_dict)
+    with pytest.raises(TypeError):
+        sparsity_config_from_dict({"mode": "fixed", "bogus_key": 1}, num_heads=2)
