@@ -63,6 +63,13 @@ class GPTConfig:
     # separate lm_head matrix (HF tie_word_embeddings=False checkpoints);
     # params then carry an extra "lm_head" [padded_vocab, n_embd] leaf
     untied_head: bool = False
+    # random-LTD (data_efficiency.data_routing.random_ltd): tokens kept per
+    # block in train mode; None/>=seq disables.  Static per compile — the
+    # engine swaps it as the schedule advances (one XLA program per value).
+    ltd_keep: Optional[int] = None
+    # non-scan path only: which block ids drop tokens (None = all); the
+    # homogeneous scan path applies LTD to every block when enabled
+    ltd_layers: Optional[Tuple[int, ...]] = None
     # pad vocab to a multiple (MXU-friendly, and divisible by tensor axis)
     vocab_multiple: int = 128
 
@@ -270,25 +277,46 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
     if cfg.remat:
         body = jax.checkpoint(body, static_argnums=())
 
+    # random-LTD: each block trains on its own sorted random token subset,
+    # the rest riding the residual stream (data_pipeline/data_routing)
+    ltd_on = (train and rng is not None and cfg.ltd_keep is not None
+              and cfg.ltd_keep < S)
+    if ltd_on:
+        from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+            sample_token_indices)
+        ltd_idx = sample_token_indices(jax.random.fold_in(rng, 99), S,
+                                       cfg.ltd_keep, cfg.n_layer)
+
     if cfg.scan_layers:
         rngs = (jax.random.split(jax.random.fold_in(rng, 7), cfg.n_layer)
                 if (rng is not None and train) else None)
 
-        def scan_body(x, layer):
-            p, r = layer
-            return body(p, x, r), None
-
-        xs = (params["blocks"], rngs) if rngs is not None else (
-            params["blocks"], jnp.zeros((cfg.n_layer, 2), jnp.uint32))
-        if rngs is None:
-            def scan_body(x, layer):  # noqa: F811 — no-dropout variant
+        if ltd_on:
+            def scan_body(x, layer):
+                p, r, idx = layer
+                sub = body(p, jnp.take(x, idx, axis=1), r)
+                return x.at[:, idx].set(sub), None
+            xs = (params["blocks"], rngs, ltd_idx)
+        elif rngs is not None:
+            def scan_body(x, layer):
+                p, r = layer
+                return body(p, x, r), None
+            xs = (params["blocks"], rngs)
+        else:
+            def scan_body(x, layer):
                 p, _ = layer
                 return body(p, x, None), None
+            xs = (params["blocks"], jnp.zeros((cfg.n_layer, 2), jnp.uint32))
         x, _ = jax.lax.scan(scan_body, x, xs)
     else:
         for i in range(cfg.n_layer):
             r = jax.random.fold_in(rng, i) if (rng is not None and train) else None
-            x = body(params["blocks"][f"h{i}"], x, r)
+            p = params["blocks"][f"h{i}"]
+            if ltd_on and (cfg.ltd_layers is None or i in cfg.ltd_layers):
+                sub = body(p, jnp.take(x, ltd_idx[i], axis=1), r)
+                x = x.at[:, ltd_idx[i]].set(sub)
+            else:
+                x = body(p, x, r)
 
     x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
     # tied embedding projection (or the untied lm_head when the source

@@ -136,7 +136,9 @@ def _fwd_kernel(cnt_ref, lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # exp(NEG_INF - NEG_INF) = 1 would fabricate mass on rows whose
+        # every entry is causally masked — zero them explicitly
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
         alpha = jnp.exp(m_prev - m_new)
         m_s[...] = m_new
         l_s[...] = l_s[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
@@ -212,7 +214,7 @@ def _dq_kernel(cnt_ref, lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             rows = qi * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 0)
             cols = col * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -249,7 +251,7 @@ def _dkv_kernel(cnt_ref, lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             rows = row * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 0)
             cols = ki * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dv_s[...] = dv_s[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),

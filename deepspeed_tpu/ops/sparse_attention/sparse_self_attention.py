@@ -44,6 +44,7 @@ class SparseSelfAttention:
         self.max_seq_length = max_seq_length
         self.master_layout = self.sparsity_config.make_layout(max_seq_length)
         self._layout_cache = {}
+        self._warned_dense_fallback = False
 
     def get_layout(self, seq_len: int) -> np.ndarray:
         """Top-left sub-layout covering ``seq_len`` tokens."""
@@ -71,6 +72,16 @@ class SparseSelfAttention:
         causal = getattr(self.sparsity_config, "attention", None) == "unidirectional"
         if rpe is None and key_padding_mask is None and attn_mask is None:
             return block_sparse_attention(query, key, value, layout, causal=causal)
+        if not self._warned_dense_fallback:
+            self._warned_dense_fallback = True
+            import logging
+
+            from deepspeed_tpu.utils.logging import log_dist
+            log_dist(
+                "SparseSelfAttention: rpe/key_padding_mask/attn_mask take the "
+                "masked-dense path (O(S²) memory) — avoid masks at long "
+                "sequence lengths or bake them into the layout",
+                ranks=[0], level=logging.WARNING)
         return sparse_reference_attention(
             query, key, value, layout, causal=causal, rpe=rpe,
             key_padding_mask=key_padding_mask, attn_mask=attn_mask,

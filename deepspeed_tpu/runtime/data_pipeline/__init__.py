@@ -1,0 +1,16 @@
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.scheduler import (
+    RandomLTDScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+    RandomLayerTokenDrop)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+    DeepSpeedDataSampler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+    DataAnalyzer)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+__all__ = ["CurriculumScheduler", "RandomLTDScheduler", "RandomLayerTokenDrop",
+           "DeepSpeedDataSampler", "DataAnalyzer", "MMapIndexedDataset",
+           "MMapIndexedDatasetBuilder"]

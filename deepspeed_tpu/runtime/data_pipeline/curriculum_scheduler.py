@@ -1,0 +1,133 @@
+"""Curriculum difficulty scheduler.
+
+Capability parity with the reference ``CurriculumScheduler``
+(``runtime/data_pipeline/curriculum_scheduler.py:11``): maps a global step
+to a difficulty value under four schedule families —
+
+- ``fixed_discrete``: explicit (difficulty, max_step) staircase,
+- ``fixed_linear``:   linear ramp min→max over ``total_curriculum_step``,
+- ``fixed_root``:     ``(step/total)**(1/root_degree)`` ramp,
+- ``custom``:         user-supplied ``fn(global_step) -> difficulty``.
+
+Difficulties snap down to multiples of ``difficulty_step``.  On TPU the
+natural ``difficulty_step`` for seqlen metrics is 128 (one MXU tile): it
+keeps every curriculum shape lane-aligned AND bounds how many distinct
+XLA programs the curriculum compiles (each difficulty = one program).
+"""
+
+import math
+from typing import Callable, Optional
+
+from deepspeed_tpu.runtime.data_pipeline import constants as C
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: dict):
+        for key in (C.CURRICULUM_LEARNING_MIN_DIFFICULTY,
+                    C.CURRICULUM_LEARNING_MAX_DIFFICULTY,
+                    C.CURRICULUM_LEARNING_SCHEDULE_TYPE):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires '{key}'")
+        self.min_difficulty = int(config[C.CURRICULUM_LEARNING_MIN_DIFFICULTY])
+        self.max_difficulty = int(config[C.CURRICULUM_LEARNING_MAX_DIFFICULTY])
+        self.schedule_type = config[C.CURRICULUM_LEARNING_SCHEDULE_TYPE]
+        self.schedule = dict(config.get(C.CURRICULUM_LEARNING_SCHEDULE_CONFIG, {}))
+        self.current_difficulty = self.min_difficulty
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        self.first_step = True
+        self._validate()
+
+    def _validate(self):
+        t, s = self.schedule_type, self.schedule
+        if t == C.CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
+            diffs = s.get(C.CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY)
+            steps = s.get(C.CURRICULUM_LEARNING_SCHEDULE_MAX_STEP)
+            if not diffs or steps is None or len(diffs) != len(steps) + 1:
+                raise ValueError(
+                    "fixed_discrete needs len(difficulty) == len(max_step) + 1 "
+                    "(the last difficulty holds for all remaining steps)")
+        elif t in (C.CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR,
+                   C.CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT):
+            for key in ((C.CURRICULUM_LEARNING_SCHEDULE_TOTAL_STEP,
+                         C.CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY_STEP)
+                        + ((C.CURRICULUM_LEARNING_SCHEDULE_ROOT_DEGREE,)
+                           if t == C.CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT else ())):
+                if key not in s:
+                    raise ValueError(f"{t} schedule requires '{key}'")
+            if s[C.CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY_STEP] % 8 != 0:
+                import logging
+                log_dist(
+                    "curriculum difficulty_step should be a multiple of 8 "
+                    "(128 recommended on TPU: MXU lane alignment and fewer "
+                    "compiled programs)", ranks=[0], level=logging.WARNING)
+        elif t == C.CURRICULUM_LEARNING_SCHEDULE_CUSTOM:
+            pass  # set_custom_get_difficulty must be called before use
+        else:
+            raise ValueError(f"unsupported curriculum schedule {t!r}")
+
+    # ------------------------------------------------------------------ #
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty: int):
+        self.current_difficulty = int(difficulty)
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self.custom_get_difficulty = fn
+
+    def get_state(self) -> dict:
+        return {
+            C.CURRICULUM_LEARNING_CURRENT_DIFFICULTY: self.current_difficulty,
+            C.CURRICULUM_LEARNING_MIN_DIFFICULTY: self.min_difficulty,
+            C.CURRICULUM_LEARNING_MAX_DIFFICULTY: self.max_difficulty,
+        }
+
+    def set_state(self, state: dict):
+        self.current_difficulty = state.get(
+            C.CURRICULUM_LEARNING_CURRENT_DIFFICULTY, self.current_difficulty)
+        self.min_difficulty = state.get(
+            C.CURRICULUM_LEARNING_MIN_DIFFICULTY, self.min_difficulty)
+        self.max_difficulty = state.get(
+            C.CURRICULUM_LEARNING_MAX_DIFFICULTY, self.max_difficulty)
+
+    # ------------------------------------------------------------------ #
+    def _discrete(self, step: int) -> int:
+        diffs = self.schedule[C.CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY]
+        steps = self.schedule[C.CURRICULUM_LEARNING_SCHEDULE_MAX_STEP]
+        for d, m in zip(diffs, steps):
+            if step <= m:
+                return d
+        return diffs[-1]
+
+    def _root(self, step: int, degree: float) -> int:
+        total = self.schedule[C.CURRICULUM_LEARNING_SCHEDULE_TOTAL_STEP]
+        granularity = self.schedule[C.CURRICULUM_LEARNING_SCHEDULE_DIFFICULTY_STEP]
+        frac = (float(step) / total) ** (1.0 / degree)
+        d = math.floor(frac * (self.max_difficulty - self.min_difficulty)
+                       + self.min_difficulty)
+        d -= d % granularity
+        return min(d, self.max_difficulty)
+
+    def get_difficulty(self, global_step: int) -> int:
+        t = self.schedule_type
+        if t == C.CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
+            return self._discrete(global_step)
+        if t == C.CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR:
+            return self._root(global_step, 1.0)
+        if t == C.CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT:
+            return self._root(
+                global_step,
+                self.schedule[C.CURRICULUM_LEARNING_SCHEDULE_ROOT_DEGREE])
+        assert self.custom_get_difficulty is not None, \
+            "custom curriculum schedule needs set_custom_get_difficulty()"
+        return self.custom_get_difficulty(global_step)
+
+    def update_difficulty(self, global_step: int) -> int:
+        new = self.get_difficulty(global_step)
+        if new != self.current_difficulty:
+            log_dist(f"curriculum difficulty {self.current_difficulty} -> "
+                     f"{new} at step {global_step}", ranks=[0])
+        self.current_difficulty = new
+        return self.current_difficulty

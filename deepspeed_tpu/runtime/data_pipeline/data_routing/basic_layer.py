@@ -1,0 +1,75 @@
+"""Random layer-token dropping (random-LTD) — functional, jit-safe.
+
+Capability parity with the reference ``RandomLayerTokenDrop``
+(``runtime/data_pipeline/data_routing/basic_layer.py:14``) and the
+``csrc/random_ltd`` token_sort/gather_scatter kernels: during training each
+wrapped layer processes only a random subset of ``keep`` tokens; the rest
+bypass the layer through the residual stream and are merged back in their
+original positions.
+
+TPU-native design (vs the reference's CUDA sort/gather kernels):
+
+- token selection = ``jax.random.permutation`` → take ``keep`` → sort
+  (sorted order preserves causality: kept token *i* precedes kept token
+  *j* in the subsequence iff it does in the full sequence, so a standard
+  causal mask on the subsequence is exact);
+- gather/scatter = ``x[:, idx]`` / ``x.at[:, idx].set`` — XLA lowers
+  these to efficient dynamic-gather on TPU, no custom kernel needed
+  (SURVEY §2.3 maps ``csrc/random_ltd`` to jnp.take/argsort);
+- ``keep`` is a static Python int: each schedule value is its own XLA
+  program (bounded by the scheduler's ``seq_per_step`` granularity).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_indices(rng, seq_len: int, keep: int, num_layers: int = 1):
+    """[num_layers, keep] sorted random token indices (one row per layer).
+
+    The analogue of ``csrc/random_ltd/token_sort.cu``: independent subsets
+    per layer, ascending order within each subset.
+    """
+    def one(k):
+        return jnp.sort(jax.random.permutation(k, seq_len)[:keep])
+    return jax.vmap(one)(jax.random.split(rng, num_layers))
+
+
+def gather_tokens(x, idx):
+    """[B, S, E] → [B, keep, E] (``gather_scatter.cu`` gather half)."""
+    return jnp.take(x, idx, axis=1)
+
+
+def scatter_tokens(x, sub, idx):
+    """Merge processed tokens back into the full sequence at ``idx``."""
+    return x.at[:, idx].set(sub.astype(x.dtype))
+
+
+class RandomLayerTokenDrop:
+    """Wrap a layer fn ``(params, x, rng, train) -> x`` with token dropping.
+
+    In train mode with a keep-length set (via :meth:`set_keep`), the layer
+    sees ``[B, keep, E]``; in eval or at full keep it runs unchanged.  The
+    reference's mask handling (``model_mask_name``) is unnecessary here:
+    causal masks are positional and survive sorted-subset selection.
+    """
+
+    def __init__(self, layer: Callable, layer_id: int = 0):
+        self.layer = layer
+        self.layer_id = layer_id
+        self.keep: Optional[int] = None
+
+    def set_keep(self, keep: Optional[int]):
+        self.keep = keep
+
+    def __call__(self, params, x, rng=None, train=False):
+        S = x.shape[1]
+        if not train or rng is None or self.keep is None or self.keep >= S:
+            return self.layer(params, x, rng, train)
+        idx = sample_token_indices(
+            jax.random.fold_in(rng, 1000 + self.layer_id), S, self.keep)[0]
+        sub = gather_tokens(x, idx)
+        sub = self.layer(params, sub, rng, train)
+        return scatter_tokens(x, sub, idx)

@@ -1,0 +1,96 @@
+"""Random-LTD token-keep scheduler.
+
+Capability parity with the reference ``RandomLTDScheduler``
+(``runtime/data_pipeline/data_routing/scheduler.py:38``): ramps the number
+of tokens the LTD layers keep from ``min_value`` to ``max_value`` over
+``require_steps`` global steps (``fixed_linear``), snapping down to
+``seq_per_step`` multiples, and accounts consumed layer-tokens.
+
+TPU note: every distinct keep-length is a distinct XLA program; a
+``seq_per_step`` of 128 keeps shapes MXU-aligned and bounds compilations.
+"""
+
+import math
+
+from deepspeed_tpu.runtime.data_pipeline import constants as C
+
+
+class BaseScheduler:
+
+    def __init__(self):
+        self.state = {}
+
+    def _fixed_root_value(self, global_step: int, root_degree: float) -> int:
+        cfg = self.state[C.RANDOM_LTD_SCHEDULE_CONFIG]
+        frac = (float(global_step) / cfg[C.RANDOM_LTD_REQUIRE_STEP]) ** (1.0 / root_degree)
+        v = math.floor(frac * (self.state[C.RANDOM_LTD_MAX_VALUE]
+                               - self.state[C.RANDOM_LTD_MIN_VALUE])
+                       + self.state[C.RANDOM_LTD_MIN_VALUE])
+        v -= v % cfg[C.RANDOM_LTD_INCREASE_STEP]
+        return min(v, self.state[C.RANDOM_LTD_MAX_VALUE])
+
+    def get_value(self, global_step: int) -> int:
+        if self.state[C.RANDOM_LTD_SCHEDULER_TYPE] == "fixed_linear":
+            return self._fixed_root_value(global_step, 1.0)
+        raise ValueError(
+            f"unsupported random-LTD schedule "
+            f"{self.state[C.RANDOM_LTD_SCHEDULER_TYPE]!r}")
+
+
+class RandomLTDScheduler(BaseScheduler):
+
+    def __init__(self, config: dict):
+        super().__init__()
+        self.model_layer_num = config[C.RANDOM_LTD_TOTAL_LAYER_NUM]
+        self.random_ltd_layer_num = config[C.RANDOM_LTD_LAYER_NUM]
+        self.config_schedule = config[C.RANDOM_LTD_SCHEDULER]
+        self.global_batch_size = config.get(C.RANDOM_LTD_GLOBAL_BATCH_SIZE, 1)
+        self.reset_to_init()
+
+    def reset_to_init(self):
+        self.state = {
+            C.RANDOM_LTD_MIN_VALUE: self.config_schedule[C.RANDOM_LTD_MIN_VALUE],
+            C.RANDOM_LTD_MAX_VALUE: self.config_schedule[C.RANDOM_LTD_MAX_VALUE],
+            C.RANDOM_LTD_CURRENT_VALUE: self.config_schedule[C.RANDOM_LTD_MIN_VALUE],
+            C.RANDOM_LTD_SCHEDULE_CONFIG:
+                self.config_schedule[C.RANDOM_LTD_SCHEDULE_CONFIG],
+            C.RANDOM_LTD_SCHEDULER_TYPE:
+                self.config_schedule[C.RANDOM_LTD_SCHEDULER_TYPE],
+            C.RANDOM_LTD_CONSUMED_LAYER_TOKENS: 0,
+            C.RANDOM_LTD_CURR_STEP: -1,
+        }
+
+    # ------------------------------------------------------------------ #
+    def get_current_seq(self) -> int:
+        return self.state[C.RANDOM_LTD_CURRENT_VALUE]
+
+    def set_current_seq(self, seq: int):
+        self.state[C.RANDOM_LTD_CURRENT_VALUE] = int(seq)
+
+    def get_random_ltd_layer_num(self) -> int:
+        return self.random_ltd_layer_num
+
+    def update_seq(self, global_step: int) -> int:
+        """Advance to ``global_step``; returns the keep-length and accounts
+        the layer-tokens consumed by one global batch at that length."""
+        if self.state[C.RANDOM_LTD_CURRENT_VALUE] < self.state[C.RANDOM_LTD_MAX_VALUE]:
+            self.state[C.RANDOM_LTD_CURRENT_VALUE] = self.get_value(global_step)
+        if global_step != self.state[C.RANDOM_LTD_CURR_STEP]:
+            full_layers = self.model_layer_num - self.random_ltd_layer_num
+            self.state[C.RANDOM_LTD_CONSUMED_LAYER_TOKENS] += self.global_batch_size * (
+                self.state[C.RANDOM_LTD_CURRENT_VALUE] * self.random_ltd_layer_num
+                + self.state[C.RANDOM_LTD_MAX_VALUE] * full_layers)
+            self.state[C.RANDOM_LTD_CURR_STEP] = global_step
+        return self.state[C.RANDOM_LTD_CURRENT_VALUE]
+
+    def get_total_layer_tokens(self, train_iters: int) -> int:
+        for step in range(train_iters):
+            self.update_seq(step)
+        return self.state[C.RANDOM_LTD_CONSUMED_LAYER_TOKENS]
+
+    # ------------------------------------------------------------------ #
+    def state_dict(self) -> dict:
+        return dict(self.state)
+
+    def load_state_dict(self, state: dict):
+        self.state.update(state)

@@ -1,0 +1,96 @@
+"""Offline dataset analyzer: compute difficulty metrics → indexed files.
+
+Capability parity with the reference ``DataAnalyzer``
+(``runtime/data_pipeline/data_sampling/data_analyzer.py:20``): maps
+user-supplied metric functions over a dataset, writes per-sample
+``index_to_metric`` and difficulty-sorted ``index_to_sample`` stores
+consumed by :class:`DeepSpeedDataSampler`, and can shard the scan across
+workers (``worker_id``/``num_workers``) with a merge step.
+"""
+
+import os
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+
+def metric_output_paths(save_path: str, metric_name: str):
+    base = os.path.join(save_path, metric_name)
+    return base + "_index_to_metric", base + "_index_to_sample"
+
+
+class DataAnalyzer:
+
+    def __init__(self, dataset, metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable],
+                 save_path: str, worker_id: int = 0, num_workers: int = 1,
+                 metric_dtype=np.int64):
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.save_path = save_path
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        self.metric_dtype = metric_dtype
+        os.makedirs(save_path, exist_ok=True)
+
+    def _worker_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        start = self.worker_id * per
+        return range(start, min(start + per, n))
+
+    def _shard_prefix(self, name: str, kind: str) -> str:
+        return os.path.join(self.save_path,
+                            f"{name}_{kind}_worker{self.worker_id}")
+
+    def run_map(self) -> Dict[str, np.ndarray]:
+        """Compute this worker's metric shard; writes
+        ``<name>_index_to_metric_worker<k>`` indexed files."""
+        out = {}
+        rng = self._worker_range()
+        for name, fn in zip(self.metric_names, self.metric_functions):
+            vals = np.asarray([fn(self.dataset[i]) for i in rng],
+                              dtype=self.metric_dtype)
+            builder = MMapIndexedDatasetBuilder(
+                self._shard_prefix(name, "index_to_metric"),
+                dtype=self.metric_dtype)
+            for v in vals:
+                builder.add_item([v])
+            builder.finalize()
+            out[name] = vals
+        return out
+
+    def run_reduce(self) -> Dict[str, np.ndarray]:
+        """Merge all worker shards; writes the final
+        ``<name>_index_to_metric`` and difficulty-sorted
+        ``<name>_index_to_sample`` stores and returns the metric arrays."""
+        results = {}
+        for name in self.metric_names:
+            metric_prefix, sample_prefix = metric_output_paths(self.save_path, name)
+            builder = MMapIndexedDatasetBuilder(metric_prefix,
+                                                dtype=self.metric_dtype)
+            for w in range(self.num_workers):
+                shard = os.path.join(self.save_path,
+                                     f"{name}_index_to_metric_worker{w}")
+                builder.merge_file(shard)
+            builder.finalize()
+
+            ds = MMapIndexedDataset(metric_prefix)
+            vals = np.asarray([ds[i][0] for i in range(len(ds))])
+            order = np.argsort(vals, kind="stable")
+            sb = MMapIndexedDatasetBuilder(sample_prefix, dtype=np.int64)
+            for i in order:
+                sb.add_item([int(i)])
+            sb.finalize()
+            results[name] = vals
+        return results
+
+    def run(self) -> Dict[str, np.ndarray]:
+        """Single-process convenience: map then reduce."""
+        self.run_map()
+        return self.run_reduce()

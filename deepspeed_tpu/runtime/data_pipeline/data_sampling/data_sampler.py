@@ -1,0 +1,155 @@
+"""Curriculum-aware deterministic data sampler.
+
+Capability parity with the reference ``DeepSpeedDataSampler``
+(``runtime/data_pipeline/data_sampling/data_sampler.py:36``): composes each
+global batch from samples whose difficulty metrics are within the current
+curriculum difficulty, then hands every data-parallel rank its micro-batch
+slice; supports value- and percentile-based difficulties, multiple metrics
+(intersection), and checkpointable state.
+
+SPMD redesign (the TPU-first difference): the reference elects rank 0 to
+build index clusters and broadcasts batches over the data-parallel group.
+Here every process runs the identical seeded numpy computation, so all
+hosts derive the same global batch with **zero communication** — the
+sampler is pure host code and never touches the device.
+
+Metric sources: in-memory numpy arrays (``metric_values={name: array}``) or
+on-disk ``MMapIndexedDataset`` prefixes built by the ``DataAnalyzer``
+(``index_to_metric_path``/``index_to_sample_path`` config keys).
+"""
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline import constants as C
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset)
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self, data_efficiency_config: dict, one_epoch_total_samples: int,
+                 micro_batch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int, gradient_accumulation_steps: int,
+                 global_rank: int = 0, drop_last: bool = True,
+                 metric_values: Optional[Dict[str, np.ndarray]] = None):
+        self.config = data_efficiency_config
+        self.one_epoch_total_samples = int(one_epoch_total_samples)
+        sampling = self.config.get(C.DATA_SAMPLING, {})
+        self.total_samples = self.one_epoch_total_samples * int(
+            sampling.get(C.DATA_SAMPLING_NUM_EPOCHS,
+                         C.DATA_SAMPLING_NUM_EPOCHS_DEFAULT))
+        self.micro_batch_size = int(micro_batch_size)
+        self.data_parallel_rank = int(data_parallel_rank)
+        self.data_parallel_size = int(data_parallel_size)
+        self.gradient_accumulation_steps = int(gradient_accumulation_steps)
+        self.global_batch_size = (self.micro_batch_size
+                                  * self.data_parallel_size
+                                  * self.gradient_accumulation_steps)
+        self.drop_last = drop_last
+        self.seed = self.config.get(C.DATA_EFFICIENCY_SEED,
+                                    C.DATA_EFFICIENCY_SEED_DEFAULT)
+        self.np_rng = np.random.default_rng(self.seed)
+
+        assert self.total_samples > 0, "no samples to consume"
+        assert self.micro_batch_size > 0 and self.data_parallel_size > 0
+        assert self.data_parallel_rank < self.data_parallel_size
+
+        self.consumed_samples = 0
+        self.curriculum_step = 0
+        self.curriculum_schedulers: Dict[str, CurriculumScheduler] = {}
+        self.difficulty_type: Dict[str, str] = {}
+        self.metric_values: Dict[str, np.ndarray] = {}
+        self.current_difficulties: Dict[str, int] = {}
+
+        cl = sampling.get(C.CURRICULUM_LEARNING, {})
+        self.curriculum_enabled = bool(cl.get(C.CURRICULUM_LEARNING_ENABLED, False))
+        if self.curriculum_enabled:
+            for metric, mcfg in cl.get(C.CURRICULUM_LEARNING_METRICS, {}).items():
+                self.curriculum_schedulers[metric] = CurriculumScheduler(mcfg)
+                self.difficulty_type[metric] = mcfg[
+                    C.CURRICULUM_LEARNING_DIFFICULTY_TYPE]
+                if metric_values and metric in metric_values:
+                    vals = np.asarray(metric_values[metric])
+                else:
+                    path = mcfg.get(C.CURRICULUM_LEARNING_METRIC_PATH)
+                    assert path, (f"metric {metric!r}: pass metric_values= or "
+                                  f"set '{C.CURRICULUM_LEARNING_METRIC_PATH}'")
+                    ds = MMapIndexedDataset(path)
+                    vals = np.asarray([ds[i][0] for i in range(len(ds))])
+                assert len(vals) >= self.one_epoch_total_samples, \
+                    f"metric {metric!r} covers {len(vals)} < {one_epoch_total_samples} samples"
+                self.metric_values[metric] = vals[:self.one_epoch_total_samples]
+
+    def __len__(self) -> int:
+        return self.total_samples
+
+    def set_custom_curriculum_learning_schedule(self, schedule_fns: dict):
+        for metric, fn in schedule_fns.items():
+            if metric in self.curriculum_schedulers:
+                self.curriculum_schedulers[metric].set_custom_get_difficulty(fn)
+
+    # ------------------------------------------------------------------ #
+    def _eligible_indices(self) -> np.ndarray:
+        """Sample indices meeting every metric's current difficulty."""
+        ok = np.ones(self.one_epoch_total_samples, dtype=bool)
+        for metric, sched in self.curriculum_schedulers.items():
+            d = self.current_difficulties[metric]
+            vals = self.metric_values[metric]
+            if self.difficulty_type[metric] == C.CURRICULUM_LEARNING_VALUE_BASED:
+                ok &= vals <= d
+            else:  # percentile-based: difficulty d keeps the easiest d%
+                cut = np.percentile(vals, d)
+                ok &= vals <= cut
+        idx = np.nonzero(ok)[0]
+        return idx if len(idx) else np.arange(self.one_epoch_total_samples)
+
+    def get_next_global_batch(self) -> np.ndarray:
+        if self.curriculum_enabled:
+            self.curriculum_step += 1
+            for metric, sched in self.curriculum_schedulers.items():
+                self.current_difficulties[metric] = sched.update_difficulty(
+                    self.curriculum_step)
+            pool = self._eligible_indices()
+        else:
+            pool = np.arange(self.one_epoch_total_samples)
+        batch = self.np_rng.choice(pool, size=self.global_batch_size,
+                                   replace=len(pool) < self.global_batch_size)
+        self.consumed_samples += self.global_batch_size
+        return batch
+
+    def get_start_end_idx(self, micro_step: int = 0):
+        """This rank's slice within a global batch for a given micro-step."""
+        offset = (micro_step * self.data_parallel_size
+                  + self.data_parallel_rank) * self.micro_batch_size
+        return offset, offset + self.micro_batch_size
+
+    def __iter__(self) -> Iterator[List[int]]:
+        """Yields this rank's micro-batches (reference semantics: iterate
+        micro-batches; every gas-th batch starts a new global batch)."""
+        while self.consumed_samples < self.total_samples:
+            batch = self.get_next_global_batch()
+            for m in range(self.gradient_accumulation_steps):
+                s, e = self.get_start_end_idx(m)
+                yield batch[s:e].tolist()
+
+    # ------------------------------------------------------------------ #
+    def state_dict(self) -> dict:
+        return {
+            C.CURRICULUM_LEARNING_STEP: self.curriculum_step,
+            C.CURRICULUM_LEARNING_CONSUMED_SAMPLES: self.consumed_samples,
+            "np_rng_state": self.np_rng.bit_generator.state,
+            "current_difficulties": dict(self.current_difficulties),
+        }
+
+    def load_state_dict(self, state: dict):
+        self.curriculum_step = state[C.CURRICULUM_LEARNING_STEP]
+        self.consumed_samples = state[C.CURRICULUM_LEARNING_CONSUMED_SAMPLES]
+        self.np_rng.bit_generator.state = state["np_rng_state"]
+        self.current_difficulties = dict(state["current_difficulties"])
+        for metric, d in self.current_difficulties.items():
+            if metric in self.curriculum_schedulers:
+                self.curriculum_schedulers[metric].set_current_difficulty(d)

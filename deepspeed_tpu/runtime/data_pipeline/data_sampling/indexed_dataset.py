@@ -1,0 +1,144 @@
+"""Memory-mapped indexed dataset (variable-length sample store).
+
+Capability parity with the reference's Megatron-style ``MMapIndexedDataset``
+(``runtime/data_pipeline/data_sampling/indexed_dataset.py:369``): a ``.bin``
+file of concatenated sample payloads plus a ``.idx`` sidecar with dtype and
+per-sample sizes, read zero-copy via ``numpy.memmap``.  Used by the data
+sampler/analyzer for index→sample and index→metric lookups at dataset
+scale without loading anything into RAM.
+
+The on-disk format is this framework's own (little-endian, numpy-native) —
+not binary-compatible with Megatron files; ``MMapIndexedDatasetBuilder``
+writes it and is the migration path.
+
+Layout of ``<path>.idx``::
+
+    magic   8 bytes  b'DSTPUIDX'
+    version u64      1
+    dtype   u8       numpy type code (index into _DTYPES)
+    count   u64      number of samples
+    sizes   u32[count]      length (elements) of each sample
+    offsets u64[count]      element offset of each sample in .bin
+"""
+
+import os
+import struct
+from typing import Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+def best_fitting_dtype(vocab_size: int):
+    """Smallest int dtype that can hold token ids (reference helper)."""
+    return np.uint16 if vocab_size is not None and vocab_size < 65500 else np.int32
+
+
+class MMapIndexedDataset:
+
+    def __init__(self, path_prefix: str):
+        self._prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(8)
+            if magic != _MAGIC:
+                raise ValueError(f"{path_prefix}.idx: bad magic {magic!r}")
+            version, = struct.unpack("<Q", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            code, = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(_DTYPES[code])
+            count, = struct.unpack("<Q", f.read(8))
+            header = f.tell()
+        self._sizes = np.memmap(index_file_path(path_prefix), dtype=np.uint32,
+                                mode="r", offset=header, shape=(count,))
+        self._offsets = np.memmap(index_file_path(path_prefix), dtype=np.uint64,
+                                  mode="r", offset=header + 4 * count,
+                                  shape=(count,))
+        self._data = np.memmap(data_file_path(path_prefix),
+                               dtype=self._dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, (int, np.integer)):
+            off, n = int(self._offsets[idx]), int(self._sizes[idx])
+            return np.asarray(self._data[off:off + n])
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        raise TypeError(f"bad index type {type(idx)}")
+
+    def get(self, idx: int, offset: int = 0, length=None) -> np.ndarray:
+        """Partial read of one sample (reference ``MMapIndexedDataset.get``)."""
+        off, n = int(self._offsets[idx]), int(self._sizes[idx])
+        length = n - offset if length is None else length
+        return np.asarray(self._data[off + offset:off + offset + length])
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(data_file_path(path_prefix))
+                and os.path.exists(index_file_path(path_prefix)))
+
+
+class MMapIndexedDatasetBuilder:
+
+    def __init__(self, out_prefix: str, dtype=np.int32):
+        self._prefix = out_prefix
+        self._dtype = np.dtype(dtype)
+        if self._dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._data_f = open(data_file_path(out_prefix), "wb")
+        self._sizes = []
+        self._offsets = []
+        self._elements = 0
+
+    def add_item(self, array) -> None:
+        arr = np.ascontiguousarray(np.asarray(array), dtype=self._dtype)
+        self._data_f.write(arr.tobytes(order="C"))
+        self._offsets.append(self._elements)
+        self._sizes.append(arr.size)
+        self._elements += arr.size
+
+    def add_items(self, arrays: Sequence) -> None:
+        for a in arrays:
+            self.add_item(a)
+
+    def merge_file(self, other_prefix: str) -> None:
+        """Append another built dataset (reference ``merge_file_``), for
+        combining per-worker shards after a parallel analyzer run."""
+        other = MMapIndexedDataset(other_prefix)
+        if other.dtype != self._dtype:
+            raise ValueError("dtype mismatch in merge")
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self) -> None:
+        self._data_f.close()
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", _DTYPE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(self._sizes)))
+            f.write(np.asarray(self._sizes, np.uint32).tobytes())
+            f.write(np.asarray(self._offsets, np.uint64).tobytes())

@@ -195,9 +195,36 @@ class DeepSpeedEngine:
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=self._config.pld_config.theta, gamma=self._config.pld_config.gamma)
 
+        # legacy curriculum learning (reference engine.py:1691-1694: the
+        # engine truncates micro-batches to the scheduled seqlen)
+        self.curriculum_scheduler_legacy = None
+        if self._config.curriculum_enabled_legacy:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+                CurriculumScheduler)
+            self.curriculum_scheduler_legacy = CurriculumScheduler(
+                self._config.curriculum_learning_legacy)
+            self._curriculum_type_legacy = self._config.curriculum_learning_legacy.get(
+                "curriculum_type", "seqlen")
+
+        # random-LTD (reference engine random_ltd_initialize): keep-length
+        # schedule; the model consumes it via the ltd_keep static config
+        self.random_ltd_scheduler = None
+        de = self._config.data_efficiency_config or {}
+        ltd_cfg = (de.get("data_routing", {}) or {}).get("random_ltd", {})
+        if de.get("enabled", False) and ltd_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline.data_routing.scheduler import (
+                RandomLTDScheduler)
+            ltd_cfg = dict(ltd_cfg)
+            ltd_cfg.setdefault("global_batch_size", self.train_batch_size())
+            self._configure_ltd_layers(ltd_cfg)
+            self.random_ltd_scheduler = RandomLTDScheduler(ltd_cfg)
+            self._apply_ltd_keep(self.random_ltd_scheduler.get_current_seq())
+
         # ---- dataloader ------------------------------------------------ #
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
+
+        self._data_post_process_func = None
 
         # ---- compiled programs (built lazily per batch structure) ------ #
         self._grad_step = None
@@ -210,6 +237,68 @@ class DeepSpeedEngine:
                  f"dtype={self.compute_dtype.__name__}, "
                  f"micro_batch={self.train_micro_batch_size_per_gpu()}, "
                  f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # Data-efficiency hooks
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _truncate_seqlen(x, seqlen: int):
+        """Curriculum seqlen: slice the sequence (2nd) dim of batch arrays."""
+        if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen:
+            return x[:, :seqlen]
+        return x
+
+    def _configure_ltd_layers(self, ltd_cfg: dict):
+        """Propagate random_ltd_layer_num/_id to the model and keep the
+        scheduler's layer-token accounting consistent with what actually
+        runs.  Per-layer selection needs per-layer heterogeneity: honored on
+        the unrolled (scan_layers=False) path; the homogeneous scan path
+        drops on every block, so the config is widened to match."""
+        import dataclasses as _dc
+        cfg = getattr(self.module, "cfg", None)
+        total = int(ltd_cfg.get("total_layer_num", 0))
+        num = int(ltd_cfg.get("random_ltd_layer_num", total))
+        if cfg is None or not hasattr(cfg, "ltd_layers") or num >= total:
+            return
+        if getattr(cfg, "scan_layers", False):
+            log_dist(
+                f"random_ltd: scan_layers model drops tokens in every block; "
+                f"widening random_ltd_layer_num {num} -> {total} (use "
+                f"scan_layers=False for per-layer selection)", ranks=[0])
+            ltd_cfg["random_ltd_layer_num"] = total
+            return
+        ids = ltd_cfg.get("random_ltd_layer_id")
+        # default: drop in the middle, keep the first/last blocks full
+        ids = tuple(ids) if ids is not None else tuple(
+            range(1, min(1 + num, total)))
+        ltd_cfg["random_ltd_layer_num"] = len(ids)
+        self.module.cfg = _dc.replace(cfg, ltd_layers=ids)
+
+    def _apply_ltd_keep(self, keep: int):
+        """Propagate the random-LTD keep-length into the model config.
+
+        ``ltd_keep`` is a static shape parameter, so a change invalidates
+        the compiled train step (bounded by the schedule's seq_per_step
+        granularity — the reference pays the same via shape-specialized
+        CUDA graphs)."""
+        import dataclasses as _dc
+        cfg = getattr(self.module, "cfg", None)
+        if cfg is None or not hasattr(cfg, "ltd_keep"):
+            log_dist("random_ltd enabled but model has no ltd_keep config — "
+                     "schedule runs without token dropping", ranks=[0])
+            return
+        max_v = self.random_ltd_scheduler.state["max_value"]
+        new = None if keep >= max_v else int(keep)
+        if cfg.ltd_keep != new:
+            self.module.cfg = _dc.replace(cfg, ltd_keep=new)
+            self._grad_step = None   # re-trace with the new static keep
+            self._eval_step = None
+            self._fused_step = None
+
+    def set_data_post_process_func(self, fn):
+        """Reference parity (engine.py): user hook applied to each batch
+        before placement."""
+        self._data_post_process_func = fn
 
     # ------------------------------------------------------------------ #
     # Model / parameter setup
@@ -556,6 +645,19 @@ class DeepSpeedEngine:
             # reference engine.py:1685-1686: PLD state is fed to the model
             kwargs.update(self.progressive_layer_drop.get_state())
             kwargs["pld_theta"] = jnp.float32(kwargs["pld_theta"])
+        if self.curriculum_scheduler_legacy is not None:
+            # reference engine.py:1691-1694: seqlen curriculum truncates the
+            # micro-batch host-side (one XLA program per difficulty value)
+            d = self.curriculum_scheduler_legacy.update_difficulty(
+                self.global_steps + 1)
+            if self._curriculum_type_legacy == "seqlen":
+                # tree-map so dict batches and nested structures truncate too
+                inputs = jax.tree.map(
+                    lambda x: self._truncate_seqlen(x, d), inputs)
+                kwargs = jax.tree.map(
+                    lambda x: self._truncate_seqlen(x, d), kwargs)
+        if self._data_post_process_func is not None:
+            inputs = self._data_post_process_func(inputs)
         if kwargs:
             batch = {"__args__": tuple(inputs), "__kwargs__": kwargs}
         else:
@@ -641,6 +743,9 @@ class DeepSpeedEngine:
                 self.lr_scheduler.step()
             if self.progressive_layer_drop is not None:
                 self.progressive_layer_drop.update_state(self.global_steps)
+            if self.random_ltd_scheduler is not None:
+                self._apply_ltd_keep(
+                    self.random_ltd_scheduler.update_seq(self.global_steps))
             if self.flops_profiler is not None:
                 self.flops_profiler.stop_profile()
                 fc = self._config.flops_profiler_config

@@ -242,3 +242,22 @@ class TestSparseSelfAttention:
         assert np.array_equal(sub, attn.master_layout[:, :4, :4])
         with pytest.raises(ValueError):
             attn.get_layout(1024)
+
+
+class TestFullyMaskedRows:
+    def test_causal_row_with_only_future_blocks_is_zero(self):
+        """A layout row containing only strictly-above-diagonal blocks must
+        produce zero output under causal masking, not the mean of v."""
+        q, k, v = _qkv(jax.random.key(7), B=1, S=128, H=1, D=32)
+        layout = np.zeros((1, 4, 4), np.int32)
+        layout[0, 0, 2] = 1                  # row 0 attends only future block 2
+        layout[0, 1:, :2] = 1                # other rows are sane
+        out = block_sparse_attention(q, k, v, layout, causal=True)
+        ref = sparse_reference_attention(q, k, v, layout, causal=True)
+        assert np.allclose(out[:, :32], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # gradients through the poisoned-lse path must stay finite and zero
+        g = jax.grad(lambda q: jnp.sum(
+            block_sparse_attention(q, k, v, layout, causal=True)))(q)
+        assert np.all(np.isfinite(g))
+        assert np.allclose(g[:, :32], 0.0, atol=1e-5)

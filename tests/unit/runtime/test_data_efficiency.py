@@ -1,0 +1,345 @@
+"""Data-efficiency pipeline tests (reference
+``tests/unit/runtime/test_data_efficiency.py`` coverage class): curriculum
+scheduling, random-LTD, indexed dataset, curriculum sampler, analyzer, and
+the engine wiring for legacy seqlen curriculum + LTD."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, RandomLayerTokenDrop,
+    RandomLTDScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+    sample_token_indices)
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.update_difficulty(0) == 8
+        assert s.update_difficulty(50) == 32
+        assert s.update_difficulty(100) == 64
+        assert s.update_difficulty(500) == 64
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 2, "max_difficulty": 10,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 2, "root_degree": 2}})
+        # sqrt ramp: at 25% of steps, half the range
+        assert s.get_difficulty(25) == 6
+        assert s.get_difficulty(100) == 10
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 3,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]}})
+        assert s.get_difficulty(3) == 1
+        assert s.get_difficulty(7) == 2
+        assert s.get_difficulty(11) == 3
+
+    def test_custom(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 100,
+            "schedule_type": "custom"})
+        s.set_custom_get_difficulty(lambda step: step * 2)
+        assert s.get_difficulty(21) == 42
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            CurriculumScheduler({"min_difficulty": 1})
+        with pytest.raises(ValueError):
+            CurriculumScheduler({
+                "min_difficulty": 1, "max_difficulty": 2,
+                "schedule_type": "fixed_discrete",
+                "schedule_config": {"difficulty": [1, 2], "max_step": [5, 9]}})
+        with pytest.raises(ValueError):
+            CurriculumScheduler({
+                "min_difficulty": 1, "max_difficulty": 2,
+                "schedule_type": "warp_speed"})
+
+    def test_state_roundtrip(self):
+        cfg = {"min_difficulty": 8, "max_difficulty": 64,
+               "schedule_type": "fixed_linear",
+               "schedule_config": {"total_curriculum_step": 100,
+                                   "difficulty_step": 8}}
+        a, b = CurriculumScheduler(cfg), CurriculumScheduler(cfg)
+        a.update_difficulty(70)
+        b.set_state(a.get_state())
+        assert b.get_current_difficulty() == a.get_current_difficulty()
+
+
+class TestRandomLTDScheduler:
+    CFG = {"total_layer_num": 4, "random_ltd_layer_num": 4,
+           "global_batch_size": 8,
+           "random_ltd_schedule": {
+               "min_value": 16, "max_value": 64,
+               "schedule_type": "fixed_linear",
+               "schedule_config": {"require_steps": 10, "seq_per_step": 16}}}
+
+    def test_ramp(self):
+        s = RandomLTDScheduler(self.CFG)
+        assert s.get_current_seq() == 16
+        assert s.update_seq(5) == 16 + (64 - 16) // 2 // 16 * 16  # 32
+        assert s.update_seq(10) == 64
+        assert s.update_seq(99) == 64
+
+    def test_consumed_layer_tokens(self):
+        s = RandomLTDScheduler(self.CFG)
+        total = s.get_total_layer_tokens(3)
+        assert total > 0
+        # all four layers drop: consumed < full-token account
+        full = 3 * 8 * 64 * 4
+        assert total < full
+
+    def test_state_roundtrip(self):
+        a, b = RandomLTDScheduler(self.CFG), RandomLTDScheduler(self.CFG)
+        a.update_seq(7)
+        b.load_state_dict(a.state_dict())
+        assert b.get_current_seq() == a.get_current_seq()
+
+
+class TestRandomLayerTokenDrop:
+    def test_indices_sorted_unique(self):
+        idx = sample_token_indices(jax.random.key(0), 64, 16, num_layers=3)
+        assert idx.shape == (3, 16)
+        for row in np.asarray(idx):
+            assert len(set(row)) == 16
+            assert np.all(np.diff(row) > 0)
+        # layers get different subsets
+        assert not np.array_equal(idx[0], idx[1])
+
+    def test_wrapper_scatters_back(self):
+        seen = {}
+
+        def layer(params, x, rng=None, train=False):
+            seen["tokens"] = x.shape[1]
+            return x + 1.0
+
+        wrapped = RandomLayerTokenDrop(layer, layer_id=0)
+        wrapped.set_keep(8)
+        x = jnp.zeros((2, 32, 4))
+        out = wrapped(None, x, rng=jax.random.key(1), train=True)
+        assert seen["tokens"] == 8
+        assert out.shape == x.shape
+        # exactly 8 token positions got the +1, the rest passed through
+        touched = np.unique(np.asarray(out)[0, :, 0])
+        assert set(touched) == {0.0, 1.0}
+        assert int((np.asarray(out)[0, :, 0] == 1.0).sum()) == 8
+
+    def test_wrapper_full_in_eval(self):
+        seen = {}
+
+        def layer(params, x, rng=None, train=False):
+            seen["tokens"] = x.shape[1]
+            return x
+
+        wrapped = RandomLayerTokenDrop(layer)
+        wrapped.set_keep(8)
+        wrapped(None, jnp.zeros((1, 32, 4)), rng=jax.random.key(1), train=False)
+        assert seen["tokens"] == 32
+
+
+class TestIndexedDataset:
+    def test_roundtrip(self, tmp_path):
+        prefix = str(tmp_path / "ds")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+        samples = [np.arange(5), np.arange(9), np.arange(2)]
+        b.add_items(samples)
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 3
+        for got, want in zip(ds[0:3], samples):
+            np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(ds.sizes, [5, 9, 2])
+        np.testing.assert_array_equal(ds.get(1, offset=2, length=3), [2, 3, 4])
+        assert MMapIndexedDataset.exists(prefix)
+
+    def test_merge(self, tmp_path):
+        for w, vals in enumerate(([1, 2], [3])):
+            b = MMapIndexedDatasetBuilder(str(tmp_path / f"w{w}"), dtype=np.int64)
+            for v in vals:
+                b.add_item([v])
+            b.finalize()
+        m = MMapIndexedDatasetBuilder(str(tmp_path / "merged"), dtype=np.int64)
+        m.merge_file(str(tmp_path / "w0"))
+        m.merge_file(str(tmp_path / "w1"))
+        m.finalize()
+        ds = MMapIndexedDataset(str(tmp_path / "merged"))
+        assert [int(ds[i][0]) for i in range(3)] == [1, 2, 3]
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "x.idx"
+        p.write_bytes(b"NOTMAGIC" + b"\0" * 32)
+        (tmp_path / "x.bin").write_bytes(b"")
+        with pytest.raises(ValueError):
+            MMapIndexedDataset(str(tmp_path / "x"))
+
+
+def _sampler_cfg(curriculum=True):
+    cfg = {"enabled": True, "seed": 42,
+           "data_sampling": {"enabled": True, "num_epochs": 2}}
+    if curriculum:
+        cfg["data_sampling"]["curriculum_learning"] = {
+            "enabled": True,
+            "curriculum_metrics": {
+                "seqlen": {"difficulty_type": "value",
+                           "clustering_type": "single_cluster",
+                           "min_difficulty": 10, "max_difficulty": 100,
+                           "schedule_type": "fixed_linear",
+                           "schedule_config": {"total_curriculum_step": 10,
+                                               "difficulty_step": 10}}}}
+    return cfg
+
+
+class TestDeepSpeedDataSampler:
+    def test_curriculum_filters_hard_samples(self):
+        metric = np.arange(100)  # sample i has difficulty i
+        s = DeepSpeedDataSampler(_sampler_cfg(), one_epoch_total_samples=100,
+                                 micro_batch_size=4, data_parallel_rank=0,
+                                 data_parallel_size=2,
+                                 gradient_accumulation_steps=1,
+                                 metric_values={"seqlen": metric})
+        first = s.get_next_global_batch()
+        assert len(first) == 8
+        assert np.max(metric[first]) <= s.current_difficulties["seqlen"] <= 20
+        for _ in range(12):
+            last = s.get_next_global_batch()
+        assert s.current_difficulties["seqlen"] == 100
+
+    def test_spmd_determinism_across_ranks(self):
+        metric = np.arange(64)
+        mk = lambda rank: DeepSpeedDataSampler(
+            _sampler_cfg(), 64, 4, rank, 2, 1, metric_values={"seqlen": metric})
+        a, b = mk(0), mk(1)
+        ga, gb = a.get_next_global_batch(), b.get_next_global_batch()
+        np.testing.assert_array_equal(ga, gb)   # identical global batch
+        s0 = a.get_start_end_idx()
+        s1 = b.get_start_end_idx()
+        assert s0 != s1                          # disjoint rank slices
+
+    def test_iter_and_state_roundtrip(self):
+        metric = np.arange(32)
+        a = DeepSpeedDataSampler(_sampler_cfg(), 32, 2, 0, 1, 2,
+                                 metric_values={"seqlen": metric})
+        it = iter(a)
+        for _ in range(4):
+            mb = next(it)
+            assert len(mb) == 2
+        state = a.state_dict()
+        b = DeepSpeedDataSampler(_sampler_cfg(), 32, 2, 0, 1, 2,
+                                 metric_values={"seqlen": metric})
+        b.load_state_dict(state)
+        np.testing.assert_array_equal(a.get_next_global_batch(),
+                                      b.get_next_global_batch())
+
+
+class TestDataAnalyzer:
+    def test_analyze_then_sample(self, tmp_path):
+        data = [np.arange(n) for n in np.random.default_rng(0).integers(5, 50, 40)]
+        analyzer = DataAnalyzer(data, ["seqlen"], [len], str(tmp_path))
+        metrics = analyzer.run()
+        np.testing.assert_array_equal(metrics["seqlen"], [len(d) for d in data])
+        # index_to_sample is difficulty-sorted
+        ds = MMapIndexedDataset(str(tmp_path / "seqlen_index_to_sample"))
+        order = [int(ds[i][0]) for i in range(len(ds))]
+        assert sorted(metrics["seqlen"]) == [len(data[i]) for i in order]
+        # the sampler consumes the metric file directly
+        cfg = _sampler_cfg()
+        cfg["data_sampling"]["curriculum_learning"]["curriculum_metrics"][
+            "seqlen"]["index_to_metric_path"] = str(tmp_path / "seqlen_index_to_metric")
+        s = DeepSpeedDataSampler(cfg, len(data), 2, 0, 1, 1)
+        batch = s.get_next_global_batch()
+        assert np.all(metrics["seqlen"][batch] <= s.current_difficulties["seqlen"])
+
+    def test_sharded_map_reduce(self, tmp_path):
+        data = [np.arange(n) for n in range(4, 20)]
+        for w in range(2):
+            DataAnalyzer(data, ["seqlen"], [len], str(tmp_path),
+                         worker_id=w, num_workers=2).run_map()
+        metrics = DataAnalyzer(data, ["seqlen"], [len], str(tmp_path),
+                               num_workers=2).run_reduce()
+        np.testing.assert_array_equal(metrics["seqlen"], [len(d) for d in data])
+
+
+class TestEngineWiring:
+    def _gpt_engine(self, extra_cfg, seq=64):
+        from deepspeed_tpu.models.gpt import GPT, GPTConfig
+        cfg = GPTConfig(vocab_size=128, n_positions=seq, n_embd=32, n_layer=2,
+                        n_head=4, dtype=jnp.float32, attn_impl="reference")
+        model = GPT(cfg)
+        config = {"train_batch_size": 8, "optimizer": {
+            "type": "Adam", "params": {"lr": 1e-3}}}
+        config.update(extra_cfg)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.key(0)),
+            config=config)
+        return engine, cfg
+
+    def test_legacy_curriculum_seqlen_truncates(self):
+        engine, _ = self._gpt_engine({
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 16, "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 16}}})
+        ids = np.random.default_rng(0).integers(0, 128, (8, 64)).astype(np.int32)
+        losses = []
+        for _ in range(5):
+            loss = engine.forward(ids, ids)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert engine.curriculum_scheduler_legacy.get_current_difficulty() == 64
+
+    def test_random_ltd_keep_schedule_applied(self):
+        engine, _ = self._gpt_engine({
+            "data_efficiency": {
+                "enabled": True,
+                "data_routing": {
+                    "enabled": True,
+                    "random_ltd": {
+                        "enabled": True, "total_layer_num": 2,
+                        "random_ltd_layer_num": 2,
+                        "random_ltd_schedule": {
+                            "min_value": 16, "max_value": 64,
+                            "schedule_type": "fixed_linear",
+                            "schedule_config": {"require_steps": 3,
+                                                "seq_per_step": 16}}}}}})
+        assert engine.module.cfg.ltd_keep == 16
+        ids = np.random.default_rng(0).integers(0, 128, (8, 64)).astype(np.int32)
+        for _ in range(4):
+            loss = engine.forward(ids, ids)
+            engine.backward(loss)
+            engine.step()
+            assert np.isfinite(float(loss))
+        # schedule reached max_value → dropping disabled again
+        assert engine.module.cfg.ltd_keep is None
+        assert engine.random_ltd_scheduler.state["consumed_layer_tokens"] > 0
+
+    def test_gpt_ltd_loss_finite_and_differentiable(self):
+        from deepspeed_tpu.models.gpt import GPTConfig, gpt_loss, init_gpt_params
+        cfg = GPTConfig(vocab_size=64, n_positions=32, n_embd=16, n_layer=2,
+                        n_head=2, dtype=jnp.float32, attn_impl="reference",
+                        ltd_keep=8)
+        params = init_gpt_params(cfg, jax.random.key(0))
+        ids = jnp.asarray(np.random.default_rng(1).integers(0, 64, (2, 32)))
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss(cfg, p, ids, ids, jax.random.key(2), True))(params)
+        assert np.isfinite(float(loss))
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(np.all(np.isfinite(g)) for g in flat)
+        assert any(np.any(g != 0) for g in flat)
