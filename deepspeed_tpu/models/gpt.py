@@ -236,42 +236,55 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
     dt = x.dtype
     r = (jax.random.split(rng, 3) if rng is not None else (None, None, None))
 
-    h = layer_norm(x, p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)
-    qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, D)
-    k = k.reshape(B, S, H, D)
-    v = v.reshape(B, S, H, D)
-    # heads sharded over tensor axis (Megatron attention parallelism)
-    q = _constrain(q, mesh_lib.BATCH_AXES, "seq", "tensor", None)
-    k = _constrain(k, mesh_lib.BATCH_AXES, "seq", "tensor", None)
-    v = _constrain(v, mesh_lib.BATCH_AXES, "seq", "tensor", None)
-    o = attention_fn(q, k, v, causal=True)
-    o = o.reshape(B, S, E)
-    o = o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
-    x = x + _dropout(o, cfg.dropout, r[0], train)
-    x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+    with jax.named_scope("attn"):
+        h = layer_norm(x, p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)
+        qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D)
+        k = k.reshape(B, S, H, D)
+        v = v.reshape(B, S, H, D)
+        # heads sharded over tensor axis (Megatron attention parallelism)
+        q = _constrain(q, mesh_lib.BATCH_AXES, "seq", "tensor", None)
+        k = _constrain(k, mesh_lib.BATCH_AXES, "seq", "tensor", None)
+        v = _constrain(v, mesh_lib.BATCH_AXES, "seq", "tensor", None)
+        o = attention_fn(q, k, v, causal=True)
+        o = o.reshape(B, S, E)
+        o = o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+        x = x + _dropout(o, cfg.dropout, r[0], train)
+        x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
 
-    h = layer_norm(x, p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)
-    h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
-    h = _activation(h, cfg.activation)
-    h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
-    x = x + _dropout(h, cfg.dropout, r[1], train)
+    with jax.named_scope("mlp"):
+        h = layer_norm(x, p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)
+        h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
+        h = _activation(h, cfg.activation)
+        h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+        x = x + _dropout(h, cfg.dropout, r[1], train)
     return _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
 
 
 def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
                 rng: Optional[Array] = None, train: bool = False,
-                attention_fn: Optional[Callable] = None) -> Array:
-    """Logits ``[batch, seq, padded_vocab]`` (bf16 compute, fp32 logits)."""
+                attention_fn: Optional[Callable] = None,
+                pld_theta: Optional[Array] = None) -> Array:
+    """Logits ``[batch, seq, padded_vocab]`` (bf16 compute, fp32 logits).
+
+    ``pld_theta`` enables progressive layer drop (reference
+    ``runtime/progressive_layer_drop.py``; engine feeds the annealed theta
+    per step): block *i* is kept with probability
+    ``1 - (i+1)/L * (1 - theta)`` — deeper blocks drop more, theta→1
+    disables dropping.  A dropped block is the identity via ``lax.cond``,
+    which TPU executes as a real dynamic branch — dropped blocks skip
+    their FLOPs, matching the reference's speedup story.
+    """
     from deepspeed_tpu.ops.attention import get_attention_fn
     attention_fn = attention_fn or get_attention_fn(cfg.attn_impl)
 
     B, S = input_ids.shape
     dt = cfg.dtype
-    x = params["wte"].astype(dt)[input_ids] + params["wpe"].astype(dt)[:S][None]
-    x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
-    x = _dropout(x, cfg.dropout, rng, train)
+    with jax.named_scope("embed"):
+        x = params["wte"].astype(dt)[input_ids] + params["wpe"].astype(dt)[:S][None]
+        x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+        x = _dropout(x, cfg.dropout, rng, train)
 
     body = partial(gpt_block, cfg, train=train, attention_fn=attention_fn)
     if cfg.remat:
@@ -286,51 +299,66 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
             sample_token_indices)
         ltd_idx = sample_token_indices(jax.random.fold_in(rng, 99), S,
                                        cfg.ltd_keep, cfg.n_layer)
+    # progressive layer drop: per-block keep flags, progressive with depth
+    pld_on = train and rng is not None and pld_theta is not None
+    if pld_on:
+        depth_frac = jnp.arange(1, cfg.n_layer + 1, dtype=jnp.float32) / cfg.n_layer
+        keep_p = 1.0 - depth_frac * (1.0 - pld_theta)
+        pld_keep = jax.random.bernoulli(jax.random.fold_in(rng, 55), keep_p)
+
+    def apply_block(p, x, r, idx=None, ltd_this_layer=True):
+        if ltd_on and idx is not None and ltd_this_layer:
+            sub = body(p, jnp.take(x, idx, axis=1), r)
+            return x.at[:, idx].set(sub)
+        return body(p, x, r)
 
     if cfg.scan_layers:
+        use_rngs = rng is not None and train
         rngs = (jax.random.split(jax.random.fold_in(rng, 7), cfg.n_layer)
-                if (rng is not None and train) else None)
-
+                if use_rngs else jnp.zeros((cfg.n_layer, 2), jnp.uint32))
+        xs = {"p": params["blocks"], "r": rngs}
         if ltd_on:
-            def scan_body(x, layer):
-                p, r, idx = layer
-                sub = body(p, jnp.take(x, idx, axis=1), r)
-                return x.at[:, idx].set(sub), None
-            xs = (params["blocks"], rngs, ltd_idx)
-        elif rngs is not None:
-            def scan_body(x, layer):
-                p, r = layer
-                return body(p, x, r), None
-            xs = (params["blocks"], rngs)
-        else:
-            def scan_body(x, layer):
-                p, _ = layer
-                return body(p, x, None), None
-            xs = (params["blocks"], jnp.zeros((cfg.n_layer, 2), jnp.uint32))
-        x, _ = jax.lax.scan(scan_body, x, xs)
+            xs["idx"] = ltd_idx
+        if pld_on:
+            xs["keep"] = pld_keep
+
+        def scan_body(x, layer):
+            r = layer["r"] if use_rngs else None
+            run = lambda xx: apply_block(layer["p"], xx, r, layer.get("idx"))
+            if pld_on:   # lax.cond: a dropped block really skips its FLOPs
+                return jax.lax.cond(layer["keep"], run, lambda xx: xx, x), None
+            return run(x), None
+
+        with jax.named_scope("blocks"):
+            x, _ = jax.lax.scan(scan_body, x, xs)
     else:
         for i in range(cfg.n_layer):
             r = jax.random.fold_in(rng, i) if (rng is not None and train) else None
             p = params["blocks"][f"h{i}"]
-            if ltd_on and (cfg.ltd_layers is None or i in cfg.ltd_layers):
-                sub = body(p, jnp.take(x, ltd_idx[i], axis=1), r)
-                x = x.at[:, ltd_idx[i]].set(sub)
+            ltd_this = cfg.ltd_layers is None or i in cfg.ltd_layers
+            run = lambda xx: apply_block(p, xx, r, ltd_idx[i] if ltd_on else None,
+                                         ltd_this)
+            if pld_on:
+                x = jax.lax.cond(pld_keep[i], run, lambda xx: xx, x)
             else:
-                x = body(p, x, r)
+                x = run(x)
 
-    x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
-    # tied embedding projection (or the untied lm_head when the source
-    # checkpoint has one); vocab-parallel → logits sharded over tensor
-    head = params["lm_head"] if cfg.untied_head else params["wte"]
-    logits = (x @ head.astype(dt).T).astype(jnp.float32)
+    with jax.named_scope("head"):
+        x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
+        # tied embedding projection (or the untied lm_head when the source
+        # checkpoint has one); vocab-parallel → logits sharded over tensor
+        head = params["lm_head"] if cfg.untied_head else params["wte"]
+        logits = (x @ head.astype(dt).T).astype(jnp.float32)
     return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
 
 
 def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
              rng: Optional[Array] = None, train: bool = True,
-             attention_fn: Optional[Callable] = None) -> Array:
+             attention_fn: Optional[Callable] = None,
+             pld_theta: Optional[Array] = None) -> Array:
     """Next-token cross-entropy, masking padded vocab entries."""
-    logits = gpt_forward(cfg, params, input_ids, rng, train, attention_fn)
+    logits = gpt_forward(cfg, params, input_ids, rng, train, attention_fn,
+                         pld_theta=pld_theta)
     return gpt_ce_loss_fn(cfg)(logits, labels)
 
 
@@ -567,9 +595,10 @@ class GPT:
     def __init__(self, cfg: GPTConfig):
         self.cfg = cfg
 
-    def __call__(self, params, batch, rng, train):
+    def __call__(self, params, batch, rng, train, pld_theta=None, **_ignored):
         input_ids, labels = batch
-        return gpt_loss(self.cfg, params, input_ids, labels, rng, train)
+        return gpt_loss(self.cfg, params, input_ids, labels, rng, train,
+                        pld_theta=pld_theta)
 
     def init_params(self, rng):
         return init_gpt_params(self.cfg, rng)

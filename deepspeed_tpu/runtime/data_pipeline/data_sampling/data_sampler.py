@@ -27,6 +27,7 @@ from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
     CurriculumScheduler)
 from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
     MMapIndexedDataset)
+from deepspeed_tpu.utils.logging import log_dist
 
 
 class DeepSpeedDataSampler:
@@ -60,6 +61,7 @@ class DeepSpeedDataSampler:
 
         self.consumed_samples = 0
         self.curriculum_step = 0
+        self._warned_empty_pool = False
         self.curriculum_schedulers: Dict[str, CurriculumScheduler] = {}
         self.difficulty_type: Dict[str, str] = {}
         self.metric_values: Dict[str, np.ndarray] = {}
@@ -105,7 +107,19 @@ class DeepSpeedDataSampler:
                 cut = np.percentile(vals, d)
                 ok &= vals <= cut
         idx = np.nonzero(ok)[0]
-        return idx if len(idx) else np.arange(self.one_epoch_total_samples)
+        if len(idx):
+            return idx
+        # nothing meets the difficulty yet: take the easiest global batch
+        # (NOT the whole dataset — that would invert easy-first ordering)
+        if not self._warned_empty_pool:
+            self._warned_empty_pool = True
+            log_dist(
+                "curriculum: no sample meets the current difficulty — "
+                "falling back to the easiest samples; check min_difficulty "
+                "against the metric range", ranks=[0])
+        order = np.lexsort(tuple(self.metric_values[m]
+                                 for m in self.curriculum_schedulers))
+        return order[:self.global_batch_size]
 
     def get_next_global_batch(self) -> np.ndarray:
         if self.curriculum_enabled:
@@ -129,12 +143,21 @@ class DeepSpeedDataSampler:
 
     def __iter__(self) -> Iterator[List[int]]:
         """Yields this rank's micro-batches (reference semantics: iterate
-        micro-batches; every gas-th batch starts a new global batch)."""
+        micro-batches; every gas-th batch starts a new global batch).
+        ``drop_last`` governs the final short batch: dropped by default,
+        otherwise yielded truncated."""
         while self.consumed_samples < self.total_samples:
+            remaining = self.total_samples - self.consumed_samples
+            if remaining < self.global_batch_size and self.drop_last:
+                return
             batch = self.get_next_global_batch()
+            if remaining < self.global_batch_size:
+                batch = batch[:remaining]
             for m in range(self.gradient_accumulation_steps):
                 s, e = self.get_start_end_idx(m)
-                yield batch[s:e].tolist()
+                micro = batch[s:e].tolist()
+                if micro:
+                    yield micro
 
     # ------------------------------------------------------------------ #
     def state_dict(self) -> dict:

@@ -63,7 +63,8 @@ class DeepSpeedDataLoader:
 
     def __init__(self, dataset, batch_size: int, collate_fn: Optional[Callable] = None,
                  mesh=None, drop_last: bool = True, shuffle: bool = True, seed: int = 0,
-                 to_device: bool = True):
+                 to_device: bool = True, data_sampler=None,
+                 num_local_io_workers: int = 0):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or _default_collate
@@ -71,6 +72,13 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.shuffle = shuffle
         self.to_device = to_device
+        # a curriculum/custom sampler yields index lists per batch
+        # (e.g. data_pipeline.DeepSpeedDataSampler); it overrides shuffling
+        self.data_sampler = data_sampler
+        # host-side prefetch: >0 overlaps dataset reads + collation with the
+        # device step (the role of the reference's worker processes +
+        # pin_memory; on TPU the transfer itself is already async)
+        self.prefetch_depth = 2 if num_local_io_workers else 0
         self._epoch = 0
         self._seed = seed
         self.len = len(dataset) // batch_size if drop_last else -(-len(dataset) // batch_size)
@@ -88,32 +96,94 @@ class DeepSpeedDataLoader:
             return rng.permutation(n)
         return np.arange(n)
 
-    def __iter__(self):
+    def _index_batches(self):
+        if self.data_sampler is not None:
+            for idx in self.data_sampler:
+                yield np.asarray(idx)
+            return
         order = self._order()
+        for b in range(self.len):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                return
+            yield idx
+
+    def _produce(self):
         nproc = jax.process_count()
         pidx = jax.process_index()
         mesh = self.mesh if self.mesh is not None else (
             mesh_lib.get_mesh() if mesh_lib.has_mesh() else None)
-        for b in range(self.len):
-            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
-            if len(idx) < self.batch_size and self.drop_last:
-                break
-            # each process loads only its contiguous shard of the batch
+        sharding = (NamedSharding(mesh, PartitionSpec(mesh_lib.BATCH_AXES))
+                    if mesh is not None else None)
+
+        def put(x):
             if nproc > 1:
+                from jax.experimental import multihost_utils
+                return multihost_utils.host_local_array_to_global_array(
+                    np.asarray(x), mesh, sharding.spec)
+            return jax.device_put(jnp.asarray(x), sharding)
+
+        for idx in self._index_batches():
+            # each process loads only its contiguous shard of the batch
+            if nproc > 1 and self.data_sampler is None:
                 per = len(idx) // nproc
                 idx = idx[pidx * per:(pidx + 1) * per]
             batch = self.collate_fn([self.dataset[int(i)] for i in idx])
             if not self.to_device or mesh is None:
                 yield batch
-                continue
-            sharding = NamedSharding(mesh, PartitionSpec(mesh_lib.BATCH_AXES))
+            else:
+                yield jax.tree.map(put, batch)
 
-            def put(x):
-                if nproc > 1:
-                    from jax.experimental import multihost_utils
-                    return multihost_utils.host_local_array_to_global_array(
-                        np.asarray(x), mesh, sharding.spec)
-                return jax.device_put(jnp.asarray(x), sharding)
+    def __iter__(self):
+        if self.prefetch_depth == 0:
+            try:
+                yield from self._produce()
+            finally:
+                self._epoch += 1
+            return
+        import queue
+        import threading
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        done = object()
+        stop = threading.Event()
+        err = []
 
-            yield jax.tree.map(put, batch)
-        self._epoch += 1
+        def worker():
+            try:
+                for item in self._produce():
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                q.put(done)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    break
+                yield item
+        finally:
+            # consumer may abandon iteration early (break / partial epoch):
+            # release the producer, drop its buffered batches, count the epoch
+            stop.set()
+            while True:
+                try:
+                    if q.get_nowait() is done:
+                        break
+                except queue.Empty:
+                    if not t.is_alive():
+                        break
+            t.join(timeout=5)
+            self._epoch += 1
+        if err:
+            raise err[0]

@@ -284,8 +284,10 @@ class DeepSpeedEngine:
         import dataclasses as _dc
         cfg = getattr(self.module, "cfg", None)
         if cfg is None or not hasattr(cfg, "ltd_keep"):
-            log_dist("random_ltd enabled but model has no ltd_keep config — "
-                     "schedule runs without token dropping", ranks=[0])
+            if not getattr(self, "_warned_no_ltd", False):
+                self._warned_no_ltd = True
+                log_dist("random_ltd enabled but model has no ltd_keep config "
+                         "— schedule runs without token dropping", ranks=[0])
             return
         max_v = self.random_ltd_scheduler.state["max_value"]
         new = None if keep >= max_v else int(keep)
@@ -761,6 +763,17 @@ class DeepSpeedEngine:
         if batch is None:
             micro_batches = [next(data_iter) for _ in range(self.gradient_accumulation_steps())]
             batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micro_batches)
+        if self.curriculum_scheduler_legacy is not None:
+            # same seqlen-curriculum hook as forward(); batch leaves are
+            # [gas, micro, seq, ...] here so the slice targets axis 2
+            d = self.curriculum_scheduler_legacy.update_difficulty(
+                self.global_steps + 1)
+            if self._curriculum_type_legacy == "seqlen":
+                batch = jax.tree.map(
+                    lambda x: x[:, :, :d] if (hasattr(x, "ndim") and x.ndim >= 3
+                                              and x.shape[2] > d) else x, batch)
+        if self._data_post_process_func is not None:
+            batch = self._data_post_process_func(batch)
         batch = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x),
                                      NamedSharding(self.mesh, PartitionSpec(None, mesh_lib.BATCH_AXES))),
@@ -870,13 +883,20 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     def deepspeed_io(self, dataset, batch_size=None, route="train", pin_memory=True,
                      data_sampler=None, collate_fn=None, num_local_io_workers=None):
+        """Reference ``engine.deepspeed_io`` (engine.py:1560).  ``route`` and
+        ``pin_memory`` are accepted for signature parity: eval routes use the
+        same sharded loader, and host→TPU transfers are always async-staged
+        (there is no pinned-memory distinction to make)."""
         from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
         return DeepSpeedDataLoader(
             dataset,
             batch_size=batch_size or self.train_micro_batch_size_per_gpu() *
             mesh_lib.get_data_parallel_world_size(),
             collate_fn=collate_fn or self.collate_fn,
-            mesh=self.mesh)
+            mesh=self.mesh,
+            shuffle=(route == "train"),
+            data_sampler=data_sampler,
+            num_local_io_workers=num_local_io_workers or 0)
 
     # ------------------------------------------------------------------ #
     # Checkpointing (reference engine.py:2816/2511)

@@ -38,57 +38,72 @@ def _sync_device():
     jax.block_until_ready(jax.device_put(0))
 
 
+class _IntervalTimer:
+    """One named timer: accumulates start→stop intervals.
+
+    Total/count accumulators (not a list of records) — the engine reads
+    these every ``steps_per_print`` and a record list would grow without
+    bound over a long run.
+    """
+
+    __slots__ = ("name", "_begin", "_running", "_total_s", "_count")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._begin = 0.0
+        self._running = False
+        self._total_s = 0.0
+        self._count = 0
+
+    def start(self, sync: bool = False):
+        if self._running:
+            raise RuntimeError(
+                f"timer {self.name!r} is running; stop() it before start()")
+        if sync:
+            _sync_device()
+        self._begin = time.time()
+        self._running = True
+
+    def stop(self, reset: bool = False, record: bool = True, sync: bool = True):
+        if not self._running:
+            raise RuntimeError(f"timer {self.name!r} stopped while not running")
+        if sync:
+            _sync_device()
+        self._running = False
+        if record:
+            self._total_s += time.time() - self._begin
+            self._count += 1
+        if reset:
+            self.reset()
+
+    def reset(self):
+        self._running = False
+        self._total_s = 0.0
+        self._count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Accumulated milliseconds; a running interval is folded in and the
+        timer keeps running."""
+        was_running = self._running
+        if was_running:
+            self.stop(sync=False)
+        ms = self._total_s * 1000.0
+        if reset:
+            self.reset()
+        if was_running:
+            self.start()
+        return ms
+
+    def mean(self) -> float:
+        """Mean interval in milliseconds."""
+        return (self._total_s / self._count) * 1000.0 if self._count else 0.0
+
+
 class SynchronizedWallClockTimer:
-    """Group of named timers, optionally synchronizing the device stream."""
+    """Registry of named interval timers, optionally fencing the device."""
 
-    class Timer:
-
-        def __init__(self, name):
-            self.name_ = name
-            self.started_ = False
-            self.start_time = time.time()
-            self.elapsed_records = []
-
-        def start(self, sync=False):
-            assert not self.started_, f"{self.name_} timer has already been started"
-            if sync:
-                _sync_device()
-            self.start_time = time.time()
-            self.started_ = True
-
-        def stop(self, reset=False, record=True, sync=True):
-            assert self.started_, "timer is not started"
-            if sync:
-                _sync_device()
-            elapsed = time.time() - self.start_time
-            if record:
-                self.elapsed_records.append(elapsed)
-            self.started_ = False
-
-        def _get_elapsed_msec(self):
-            return sum(self.elapsed_records) * 1000.0
-
-        def reset(self):
-            self.started_ = False
-            self.elapsed_records = []
-
-        def elapsed(self, reset=True):
-            """Total recorded time in milliseconds."""
-            started = self.started_
-            if started:
-                self.stop(record=True)
-            elapsed = self._get_elapsed_msec()
-            if reset:
-                self.reset()
-            if started:
-                self.start()
-            return elapsed
-
-        def mean(self):
-            if not self.elapsed_records:
-                return 0.0
-            return (sum(self.elapsed_records) / len(self.elapsed_records)) * 1000.0
-
+    # engine code does `timers.Timer` in a couple of spots; keep the alias
+    Timer = _IntervalTimer
 
     def __init__(self):
         self.timers = {}
@@ -98,7 +113,7 @@ class SynchronizedWallClockTimer:
 
     def __call__(self, name):
         if name not in self.timers:
-            self.timers[name] = self.Timer(name)
+            self.timers[name] = _IntervalTimer(name)
         return self.timers[name]
 
     @staticmethod

@@ -1,0 +1,251 @@
+"""PLD wiring, flops-profiler tables, dataloader sampler/prefetch, timers —
+the config surfaces VERDICT r1 flagged as accepted-but-ignored, now live."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt import GPT, GPTConfig
+
+
+def _engine(extra, model=None):
+    model = model or GPT(GPTConfig(vocab_size=128, n_positions=64, n_embd=32,
+                                   n_layer=2, n_head=4, dtype=jnp.float32,
+                                   attn_impl="reference"))
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    config.update(extra)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(jax.random.key(0)),
+        config=config)
+    return engine
+
+
+IDS = np.random.default_rng(0).integers(0, 128, (8, 64)).astype(np.int32)
+
+
+class TestPLD:
+    def test_pld_trains_and_anneals(self):
+        engine = _engine({"progressive_layer_drop":
+                          {"enabled": True, "theta": 0.5, "gamma": 0.001}})
+        assert engine.progressive_layer_drop is not None
+        for _ in range(3):
+            loss = engine.forward(IDS, IDS)
+            engine.backward(loss)
+            engine.step()
+            assert np.isfinite(float(loss))
+        # theta anneals from 1.0 down toward the configured floor
+        assert 0.5 < engine.progressive_layer_drop.get_theta() < 1.0
+
+    def test_theta_one_matches_no_pld(self):
+        """theta=1.0 keeps every layer — losses must equal the PLD-off run."""
+        e1 = _engine({"progressive_layer_drop":
+                      {"enabled": True, "theta": 1.0, "gamma": 0.0}})
+        e2 = _engine({})
+        l1 = float(e1.forward(IDS, IDS))
+        l2 = float(e2.forward(IDS, IDS))
+        assert l1 == pytest.approx(l2, rel=1e-5)
+
+    def test_low_theta_changes_training(self):
+        def losses(extra):
+            e = _engine(extra)
+            out = []
+            for _ in range(5):
+                l = e.forward(IDS, IDS)
+                e.backward(l)
+                e.step()
+                out.append(float(l))
+            return out
+
+        # aggressive anneal: theta ~0.1 within a couple of steps, so layers
+        # actually drop and the training trajectory diverges from PLD-off
+        with_pld = losses({"progressive_layer_drop":
+                           {"enabled": True, "theta": 0.1, "gamma": 1.0}})
+        without = losses({})
+        assert any(abs(a - b) > 1e-6 for a, b in zip(with_pld, without))
+
+
+class TestFlopsProfilerTables:
+    def test_jaxpr_cost_table_scopes_and_scan(self):
+        from deepspeed_tpu.profiling.flops_profiler import jaxpr_cost_table
+
+        def f(x, w):
+            with jax.named_scope("mlp"):
+                def body(c, _):
+                    with jax.named_scope("layer"):
+                        return jnp.tanh(c @ w), None
+                c, _ = jax.lax.scan(body, x, None, length=4)
+            with jax.named_scope("head"):
+                return jnp.sum(c @ w)
+
+        rows = jaxpr_cost_table(f, jnp.ones((8, 16)), jnp.ones((16, 16)))
+        table = {(r[0], r[1]): (r[2], r[3]) for r in rows}
+        # scan-scaled matmul: 2*8*16*16 * 4 trips
+        assert table[("mlp/layer", "dot_general")] == (4 * 4096, 4)
+        assert table[("head", "dot_general")] == (4096, 1)
+
+    def test_module_depth_merges(self):
+        from deepspeed_tpu.profiling.flops_profiler import jaxpr_cost_table
+
+        def f(x):
+            with jax.named_scope("a"):
+                with jax.named_scope("b1"):
+                    x = x @ x
+                with jax.named_scope("b2"):
+                    x = x @ x
+            return x
+
+        deep = jaxpr_cost_table(f, jnp.ones((8, 8)))
+        shallow = jaxpr_cost_table(f, jnp.ones((8, 8)), module_depth=1)
+        assert {r[0] for r in deep} == {"a/b1", "a/b2"}
+        assert {r[0] for r in shallow} == {"a"}
+        assert shallow[0][2] == sum(r[2] for r in deep)
+
+    def test_engine_profiler_prints_table(self, capsys, tmp_path):
+        out = tmp_path / "prof.txt"
+        engine = _engine({"flops_profiler": {"enabled": True, "profile_step": 1,
+                                             "detailed": True,
+                                             "output_file": str(out)}})
+        loss = engine.forward(IDS, IDS)
+        engine.backward(loss)
+        engine.step()
+        text = out.read_text()
+        assert "flops per step" in text
+        assert "dot_general" in text          # per-module rows present
+        assert "blocks" in text               # model named_scope attributed
+
+
+class TestDataLoaderArgs:
+    def test_data_sampler_drives_batches(self):
+        data = [(np.full((4,), i, np.int32), np.int32(i)) for i in range(32)]
+        sampler = [[0, 1], [2, 3], [30, 31]]
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        loader = DeepSpeedDataLoader(data, batch_size=2, to_device=False,
+                                     data_sampler=sampler)
+        batches = list(loader)
+        assert len(batches) == 3
+        np.testing.assert_array_equal(batches[2][1], [30, 31])
+
+    def test_prefetch_matches_sync(self):
+        data = [(np.arange(4) + i, np.int32(i)) for i in range(16)]
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        a = DeepSpeedDataLoader(data, batch_size=4, to_device=False,
+                                shuffle=False)
+        b = DeepSpeedDataLoader(data, batch_size=4, to_device=False,
+                                shuffle=False, num_local_io_workers=2)
+        for (xa, ya), (xb, yb) in zip(a, b):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+
+    def test_prefetch_propagates_errors(self):
+        class Bad:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        loader = DeepSpeedDataLoader(Bad(), batch_size=2, to_device=False,
+                                     num_local_io_workers=1)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(loader)
+
+    def test_engine_deepspeed_io_passthrough(self):
+        engine = _engine({})
+        data = [(IDS[0], IDS[0]) for _ in range(16)]
+        loader = engine.deepspeed_io(data, route="eval", num_local_io_workers=2)
+        assert loader.shuffle is False
+        assert loader.prefetch_depth > 0
+
+
+class TestTimers:
+    def test_interval_timer_accumulates(self):
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+        timers = SynchronizedWallClockTimer()
+        t = timers("x")
+        t.start()
+        t.stop(sync=False)
+        t.start()
+        t.stop(sync=False)
+        assert t.mean() >= 0.0
+        assert t.elapsed(reset=True) >= 0.0
+        assert t.elapsed(reset=False) == 0.0
+
+    def test_double_start_raises(self):
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+        t = SynchronizedWallClockTimer()("y")
+        t.start()
+        with pytest.raises(RuntimeError):
+            t.start()
+        t.stop(sync=False)
+        with pytest.raises(RuntimeError):
+            t.stop(sync=False)
+
+
+class TestReviewFixes:
+    def test_prefetch_early_break_cleans_up(self):
+        import threading
+        data = [(np.arange(4) + i, np.int32(i)) for i in range(64)]
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        loader = DeepSpeedDataLoader(data, batch_size=4, to_device=False,
+                                     shuffle=False, num_local_io_workers=1)
+        before = threading.active_count()
+        for n, _ in enumerate(loader):
+            if n == 1:
+                break
+        # producer thread released; epoch advanced despite the early exit
+        import time
+        for _ in range(50):
+            if threading.active_count() <= before:
+                break
+            time.sleep(0.05)
+        assert threading.active_count() <= before
+        assert loader._epoch == 1
+
+    def test_train_batch_applies_curriculum(self):
+        engine = _engine({
+            "gradient_accumulation_steps": 1,
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 16, "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 16}}})
+        batch = (IDS[None], IDS[None])      # [gas=1, micro, seq]
+        loss = engine.train_batch(batch=batch)
+        assert np.isfinite(float(loss))
+        assert engine.curriculum_scheduler_legacy.get_current_difficulty() < 64
+
+    def test_sampler_empty_pool_takes_easiest(self):
+        metric = np.arange(100, 200)        # nothing <= min_difficulty 10
+        s = DeepSpeedDataSamplerFactory(metric)
+        batch = s.get_next_global_batch()
+        # fell back to the easiest samples, not uniform over the dataset
+        assert np.max(metric[batch]) <= metric[np.argsort(metric)][s.global_batch_size - 1]
+
+    def test_sampler_drop_last(self):
+        metric = np.arange(20)
+        s = DeepSpeedDataSamplerFactory(metric, num_epochs=1)
+        consumed = sum(len(mb) for mb in s)
+        assert consumed <= 20
+
+
+def DeepSpeedDataSamplerFactory(metric, num_epochs=2):
+    from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler
+    cfg = {"enabled": True, "seed": 42,
+           "data_sampling": {"enabled": True, "num_epochs": num_epochs,
+               "curriculum_learning": {
+                   "enabled": True,
+                   "curriculum_metrics": {
+                       "seqlen": {"difficulty_type": "value",
+                                  "clustering_type": "single_cluster",
+                                  "min_difficulty": 10, "max_difficulty": 100,
+                                  "schedule_type": "fixed_linear",
+                                  "schedule_config": {"total_curriculum_step": 10,
+                                                      "difficulty_step": 10}}}}}}
+    return DeepSpeedDataSampler(cfg, len(metric), 3, 0, 1, 1,
+                                metric_values={"seqlen": metric})
