@@ -1,0 +1,11 @@
+from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.autotuning.scheduler import (CONFIG_PATH_ENV,
+                                                METRIC_PATH_ENV,
+                                                ResourceManager, write_metrics)
+from deepspeed_tpu.autotuning.tuner import (BaseTuner, GridSearchTuner,
+                                            ModelBasedTuner, RandomTuner,
+                                            RidgeCostModel)
+
+__all__ = ["Autotuner", "ResourceManager", "write_metrics", "BaseTuner",
+           "GridSearchTuner", "RandomTuner", "ModelBasedTuner",
+           "RidgeCostModel", "METRIC_PATH_ENV", "CONFIG_PATH_ENV"]

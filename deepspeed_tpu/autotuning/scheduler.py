@@ -1,0 +1,97 @@
+"""Experiment scheduler / resource manager.
+
+Reference: ``deepspeed/autotuning/scheduler.py`` (``ResourceManager:33``) —
+reserves host slots, launches each experiment as a training run with its
+mutated DS config, and parses the metric from the experiment's results
+file.  TPU redesign: an experiment is one subprocess (per-host spawning is
+the `dst` launcher's job, which the command template can invoke); the
+engine drops ``metrics.json`` when ``DS_AUTOTUNING_METRIC_PATH`` is set.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+METRIC_PATH_ENV = "DS_AUTOTUNING_METRIC_PATH"
+CONFIG_PATH_ENV = "DS_AUTOTUNING_CONFIG"
+
+
+class ResourceManager:
+    """Run experiments and collect metric values.
+
+    ``cmd`` is the training command template (list of argv tokens); each
+    experiment gets its own directory with ``ds_config.json`` +
+    ``metrics.json`` and the env vars ``DS_AUTOTUNING_CONFIG`` /
+    ``DS_AUTOTUNING_METRIC_PATH`` pointing at them.  User scripts pass the
+    config path into ``deepspeed_tpu.initialize`` (or read it themselves);
+    the engine writes the metric file automatically.
+    """
+
+    def __init__(self, exps_dir: str, cmd: Optional[List[str]] = None,
+                 metric: str = "throughput", timeout: int = 1800):
+        self.exps_dir = exps_dir
+        self.cmd = cmd
+        self.metric = metric
+        self.timeout = timeout
+        self.finished_experiments: List[Dict] = []
+        os.makedirs(exps_dir, exist_ok=True)
+
+    def experiment_dir(self, name: str) -> str:
+        d = os.path.join(self.exps_dir, name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def run_experiment(self, name: str, ds_config: Dict) -> Optional[float]:
+        """Launch one experiment; returns the metric value or None."""
+        exp_dir = self.experiment_dir(name)
+        cfg_path = os.path.join(exp_dir, "ds_config.json")
+        metric_path = os.path.join(exp_dir, "metrics.json")
+        with open(cfg_path, "w") as f:
+            json.dump(ds_config, f, indent=2)
+        env = dict(os.environ)
+        env[CONFIG_PATH_ENV] = cfg_path
+        env[METRIC_PATH_ENV] = metric_path
+        log_path = os.path.join(exp_dir, "stdout.log")
+        assert self.cmd, "ResourceManager needs a training command"
+        try:
+            with open(log_path, "w") as log_f:
+                proc = subprocess.run(self.cmd, env=env, stdout=log_f,
+                                      stderr=subprocess.STDOUT,
+                                      timeout=self.timeout)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            rc = -1
+        val = self.parse_results(metric_path)
+        self.finished_experiments.append(
+            {"name": name, "ds_config": ds_config, "rc": rc,
+             self.metric: val, "exp_dir": exp_dir})
+        return val if rc == 0 else None
+
+    def parse_results(self, metric_path: str) -> Optional[float]:
+        if not os.path.exists(metric_path):
+            return None
+        try:
+            with open(metric_path) as f:
+                data = json.load(f)
+            return float(data.get(self.metric)) if self.metric in data else None
+        except (ValueError, OSError):
+            return None
+
+    def status(self) -> str:
+        ok = sum(1 for e in self.finished_experiments if e[self.metric] is not None)
+        return f"{ok}/{len(self.finished_experiments)} experiments succeeded"
+
+    def clear(self):
+        self.finished_experiments = []
+
+
+def write_metrics(path: str, metrics: Dict):
+    """Engine-side metric dump (atomic-ish)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(metrics, f)
+    os.replace(tmp, path)

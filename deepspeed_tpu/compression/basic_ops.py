@@ -1,0 +1,142 @@
+"""Compression primitives: fake quantization and pruning masks.
+
+Functional counterparts of the reference's compressed layer methods
+(``deepspeed/compression/basic_layer.py``: ``LinearLayer_Compress``
+enable_weight_quantization / enable_*_pruning and ``QuantAct``) and the
+``csrc/quantization`` fake-quant kernels.  Torch mutates module state;
+here every technique is a pure array transform the training step jits —
+fake-quantized weights get straight-through gradients via
+``stop_gradient`` algebra, masks are computed from weight statistics.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(w, wq):
+    """Straight-through estimator: forward wq, gradient of identity."""
+    return w + jax.lax.stop_gradient(wq - w)
+
+
+def _grouped(w, groups: int):
+    flat = w.reshape(-1)
+    n = flat.shape[0]
+    g = max(1, min(groups, n))
+    pad = (-n) % g
+    flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(g, -1), n, w.shape
+
+
+def quantize_weight(w, bits: int, quant_type: str = "symmetric",
+                    rounding: str = "nearest", groups: int = 1,
+                    rng: Optional[jax.Array] = None):
+    """Fake-quantize ``w`` to ``bits`` with STE gradients.
+
+    symmetric: scale = max|w| per group, levels in [-(2^{b-1}-1), 2^{b-1}-1];
+    asymmetric: affine min/max mapping to [0, 2^b - 1];
+    stochastic rounding uses ``rng`` (the reference's
+    ``WEIGHT_QUANTIZE_STOCHASTIC_ROUNDING``).
+    """
+    gw, n, shape = _grouped(w.astype(jnp.float32), groups)
+
+    def rnd(x):
+        if rounding == "stochastic":
+            assert rng is not None, "stochastic rounding needs an rng"
+            return jnp.floor(x + jax.random.uniform(rng, x.shape))
+        return jnp.round(x)
+
+    if quant_type == "symmetric":
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(gw), axis=1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-12)
+        q = jnp.clip(rnd(gw / scale), -qmax, qmax) * scale
+    elif quant_type == "asymmetric":
+        qmax = 2.0 ** bits - 1
+        lo = jnp.min(gw, axis=1, keepdims=True)
+        hi = jnp.max(gw, axis=1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / qmax, 1e-12)
+        q = jnp.clip(rnd((gw - lo) / scale), 0, qmax) * scale + lo
+    else:
+        raise ValueError(f"unknown quantization_type {quant_type!r}")
+
+    wq = q.reshape(-1)[:n].reshape(shape).astype(w.dtype)
+    return _ste(w, wq)
+
+
+def quantize_activation(x, bits: int = 8, quant_type: str = "symmetric",
+                        dynamic: bool = True, static_range: float = 1.0):
+    """Activation fake-quant (reference ``QuantAct``): dynamic per-tensor
+    range or a calibrated static range."""
+    xf = x.astype(jnp.float32)
+    if quant_type == "symmetric":
+        qmax = 2.0 ** (bits - 1) - 1
+        r = jnp.max(jnp.abs(xf)) if dynamic else static_range
+        scale = jnp.maximum(r / qmax, 1e-12)
+        q = jnp.clip(jnp.round(xf / scale), -qmax, qmax) * scale
+    else:
+        qmax = 2.0 ** bits - 1
+        lo = jnp.min(xf) if dynamic else -static_range
+        hi = jnp.max(xf) if dynamic else static_range
+        scale = jnp.maximum((hi - lo) / qmax, 1e-12)
+        q = jnp.clip(jnp.round((xf - lo) / scale), 0, qmax) * scale + lo
+    return _ste(x, q.astype(x.dtype))
+
+
+# --------------------------------------------------------------------------- #
+# Pruning masks (reference enable_{sparse,row,head,channel}_pruning; methods
+# 'l1' = magnitude, 'topk' = keep largest by |w|)
+# --------------------------------------------------------------------------- #
+def _threshold_keep(scores, ratio):
+    """Boolean mask keeping the top (1 - ratio) fraction by score."""
+    k = scores.size - int(round(scores.size * ratio))
+    if k <= 0:
+        return jnp.zeros_like(scores, dtype=bool)
+    thresh = jnp.sort(scores.reshape(-1))[-k]
+    return scores >= thresh
+
+
+def sparse_mask(w, ratio: float, method: str = "l1"):
+    """Elementwise (unstructured) mask dropping ``ratio`` of the weights."""
+    scores = jnp.abs(w.astype(jnp.float32))
+    if method not in ("l1", "topk"):
+        raise ValueError(f"unknown pruning method {method!r}")
+    return _threshold_keep(scores, ratio)
+
+
+def row_mask(w, ratio: float, method: str = "l1"):
+    """[out] mask over output rows; ``w`` is [..., in, out] (column-major
+    dense layout used by this framework's blocks)."""
+    scores = jnp.linalg.norm(w.astype(jnp.float32).reshape(-1, w.shape[-1]),
+                             ord=1, axis=0)
+    return _threshold_keep(scores, ratio)
+
+
+def channel_mask(w, ratio: float, method: str = "l1"):
+    """[in] mask over input channels (dim -2)."""
+    wf = jnp.moveaxis(w.astype(jnp.float32), -2, 0).reshape(w.shape[-2], -1)
+    scores = jnp.linalg.norm(wf, ord=1, axis=1)
+    return _threshold_keep(scores, ratio)
+
+
+def head_mask(w, ratio: float, num_heads: int):
+    """[num_heads] mask over attention heads; ``w`` is the output
+    projection [..., E, E] whose INPUT dim is split into heads."""
+    E = w.shape[-2]
+    assert E % num_heads == 0, f"{E} not divisible into {num_heads} heads"
+    per = E // num_heads
+    wf = w.astype(jnp.float32).reshape(-1, num_heads, per, w.shape[-1])
+    scores = jnp.sum(jnp.abs(wf), axis=(0, 2, 3))
+    return _threshold_keep(scores, ratio)
+
+
+def apply_row_mask(w, mask):
+    return w * mask.astype(w.dtype)
+
+
+def apply_head_mask(w, mask, num_heads: int):
+    E = w.shape[-2]
+    per = E // num_heads
+    m = jnp.repeat(mask, per).astype(w.dtype)       # [E]
+    return w * m[..., :, None]

@@ -1,0 +1,55 @@
+"""Compression schedule: when each technique switches on.
+
+Reference: ``deepspeed/compression/scheduler.py``
+(``compression_scheduler``): each technique has a ``schedule_offset``
+(global step at which it activates); ``check_all_modules`` flips layer
+flags once the offset passes.  Here the scheduler returns a static
+enabled-dict; the engine re-jits the (pure) transform when a flag flips
+— bounded by the number of techniques.
+"""
+
+from typing import Dict
+
+from deepspeed_tpu.utils.logging import log_dist
+
+TECHNIQUES = ("weight_quantization", "activation_quantization",
+              "sparse_pruning", "row_pruning", "head_pruning",
+              "channel_pruning")
+
+
+class CompressionScheduler:
+
+    def __init__(self, compression_config: Dict):
+        self.config = compression_config or {}
+        self.offsets: Dict[str, int] = {}
+        self.enabled: Dict[str, bool] = {}
+        for t in TECHNIQUES:
+            shared = (self.config.get(t, {}) or {}).get("shared_parameters", {})
+            if shared.get("enabled", False):
+                self.offsets[t] = int(shared.get("schedule_offset", 0))
+                self.enabled[t] = False
+
+    def check_all_modules(self, global_step: int) -> Dict[str, bool]:
+        """Enabled-flags for ``global_step``; logs each activation once."""
+        for t, off in self.offsets.items():
+            if not self.enabled[t] and global_step >= off:
+                self.enabled[t] = True
+                log_dist(f"compression: {t} active from step {global_step}",
+                         ranks=[0])
+        return dict(self.enabled)
+
+    # per-technique views (reference check_* methods)
+    def check_weight_quantization(self, step):
+        return self.check_all_modules(step).get("weight_quantization", False)
+
+    def check_sparse_pruning(self, step):
+        return self.check_all_modules(step).get("sparse_pruning", False)
+
+    def check_row_pruning(self, step):
+        return self.check_all_modules(step).get("row_pruning", False)
+
+    def check_head_pruning(self, step):
+        return self.check_all_modules(step).get("head_pruning", False)
+
+    def check_channel_pruning(self, step):
+        return self.check_all_modules(step).get("channel_pruning", False)

@@ -270,7 +270,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
     scale = 1.0 / np.sqrt(D)
 
     from deepspeed_tpu.parallel import mesh as mesh_lib
-    if mesh_lib.has_mesh():
+    if mesh_lib.has_mesh() and not mesh_lib.in_manual_mode():
         mesh = mesh_lib.get_mesh()
         batch_div = int(np.prod([mesh.shape[a] for a in mesh_lib.BATCH_AXES]))
         head_div = int(mesh.shape["tensor"] * mesh.shape["seq"])

@@ -1,0 +1,104 @@
+"""Grouped integer quantize/dequantize ops.
+
+Capability parity with the reference quantizer kernels
+(``csrc/quantization/{quantize.cu,fake_quantizer.cu,pt_binding.cpp}`` and
+the ``ds_quantizer`` wrapper ``ops/quantizer/quantizer.py:14``): grouped
+symmetric/asymmetric int8/int4 quantization with nearest or stochastic
+rounding, returning REAL integer payloads + per-group scales (for
+storage/wire use — the fake-quant STE path for training lives in
+``compression/basic_ops.py``).  Pure jnp: XLA fuses the scale/round/clip
+chain; int4 packs two nibbles per int8 byte.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedTensor(NamedTuple):
+    data: jax.Array        # int8 payload ([groups, elems] or packed nibbles)
+    scale: jax.Array       # [groups, 1] float32
+    zero_point: jax.Array  # [groups, 1] float32 (0 for symmetric)
+    shape: Tuple[int, ...]
+    bits: int
+    symmetric: bool
+
+
+def _group(x, groups: int):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    assert n % groups == 0, f"{n} elements not divisible into {groups} groups"
+    return flat.reshape(groups, -1)
+
+
+def quantize(x, bits: int = 8, groups: int = 1, symmetric: bool = True,
+             stochastic: bool = False,
+             rng: Optional[jax.Array] = None) -> QuantizedTensor:
+    assert bits in (4, 8), "int8 and int4 supported"
+    g = _group(x.astype(jnp.float32), groups)
+
+    def rnd(v):
+        if stochastic:
+            assert rng is not None
+            return jnp.floor(v + jax.random.uniform(rng, v.shape))
+        return jnp.round(v)
+
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.maximum(jnp.max(jnp.abs(g), axis=1, keepdims=True) / qmax,
+                            1e-12)
+        zp = jnp.zeros_like(scale)
+        q = jnp.clip(rnd(g / scale), -qmax - 1, qmax)
+    else:
+        qmax = 2.0 ** bits - 1
+        lo = jnp.min(g, axis=1, keepdims=True)
+        hi = jnp.max(g, axis=1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / qmax, 1e-12)
+        zp = lo
+        q = jnp.clip(rnd((g - lo) / scale), 0, qmax) - 2.0 ** (bits - 1)
+
+    qi = q.astype(jnp.int8)
+    if bits == 4:
+        qi = _pack_int4(qi)
+    return QuantizedTensor(qi, scale, zp, tuple(x.shape), bits, symmetric)
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.float32) -> jax.Array:
+    q = qt.data
+    if qt.bits == 4:
+        q = _unpack_int4(q)
+    qf = q.astype(jnp.float32)
+    if not qt.symmetric:
+        # shift back from the centered int8 representation
+        qf = qf + 2.0 ** (qt.bits - 1)
+    out = qf * qt.scale + qt.zero_point
+    n = 1
+    for s in qt.shape:
+        n *= s
+    return out.reshape(-1)[:n].reshape(qt.shape).astype(dtype)
+
+
+def _pack_int4(q: jax.Array) -> jax.Array:
+    """[g, n] int8 in [-8, 7] → [g, n/2] int8, two nibbles per byte."""
+    g, n = q.shape
+    assert n % 2 == 0, "int4 packing needs an even group size"
+    u = (q.astype(jnp.int32) & 0xF).reshape(g, n // 2, 2)
+    return (u[..., 0] | (u[..., 1] << 4)).astype(jnp.int8)
+
+
+def _unpack_int4(p: jax.Array) -> jax.Array:
+    u = p.astype(jnp.int32) & 0xFF
+    lo = (u & 0xF)
+    hi = (u >> 4) & 0xF
+    both = jnp.stack([lo, hi], axis=-1).reshape(p.shape[0], -1)
+    return jnp.where(both >= 8, both - 16, both).astype(jnp.int8)
+
+
+def quantize_dequantize(x, bits: int = 8, groups: int = 1,
+                        symmetric: bool = True, stochastic: bool = False,
+                        rng: Optional[jax.Array] = None) -> jax.Array:
+    """Round-trip (the ``fake_quantizer.cu`` capability) without STE —
+    for inference weight conversion; training uses compression.basic_ops."""
+    return dequantize(quantize(x, bits, groups, symmetric, stochastic, rng),
+                      dtype=x.dtype)

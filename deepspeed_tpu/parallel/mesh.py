@@ -164,11 +164,34 @@ def get_sequence_parallel_world_size() -> int:
     return axis_size("seq")
 
 
+import contextlib
+import threading
+
+_manual = threading.local()
+
+
+@contextlib.contextmanager
+def manual_sharding():
+    """Mark code being traced inside a ``shard_map`` body: sharding
+    constraints are per-device no-ops there (and would be rejected by jax).
+    Trace-time only — wrap the body function's execution."""
+    prev = getattr(_manual, "on", False)
+    _manual.on = True
+    try:
+        yield
+    finally:
+        _manual.on = prev
+
+
+def in_manual_mode() -> bool:
+    return getattr(_manual, "on", False)
+
+
 def constrain(x, *spec):
     """Activation sharding constraint on the global mesh; no-op when no
     mesh is set (single place for the has_mesh/with_sharding_constraint
     idiom used by models, MoE and sequence parallelism)."""
-    if not has_mesh():
+    if not has_mesh() or in_manual_mode():
         return x
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(get_mesh(), PartitionSpec(*spec)))

@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    CheckpointEngine, LocalCheckpointEngine, OrbaxCheckpointEngine,
+    get_checkpoint_engine)
+
+__all__ = ["CheckpointEngine", "OrbaxCheckpointEngine",
+           "LocalCheckpointEngine", "get_checkpoint_engine"]

@@ -31,9 +31,18 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 LATEST_FILE = "latest"
 
 
-def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.PyTreeCheckpointer()
+def _ckpt_engine(engine):
+    """The engine's pluggable storage backend (reference
+    ``checkpoint_engine/checkpoint_engine.py:9`` ABC; selected by the
+    ``checkpoint.engine`` / ``checkpoint.async_save`` config keys)."""
+    ce = getattr(engine, "checkpoint_engine", None)
+    if ce is None:
+        from deepspeed_tpu.runtime.checkpoint_engine import get_checkpoint_engine
+        cc = getattr(engine._config, "checkpoint_config", None)
+        ce = get_checkpoint_engine(getattr(cc, "engine", "orbax"),
+                                   async_save=getattr(cc, "async_save", False))
+        engine.checkpoint_engine = ce
+    return ce
 
 
 def _engine_tree(engine) -> Dict[str, Any]:
@@ -52,8 +61,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     ckpt_dir = os.path.join(save_dir, tag)
     os.makedirs(save_dir, exist_ok=True)
 
+    ce = _ckpt_engine(engine)
+    ce.create(tag)
     state_path = os.path.join(ckpt_dir, "state")
-    _checkpointer().save(state_path, _engine_tree(engine), force=True)
+    ce.save(_engine_tree(engine), state_path)
 
     meta = {
         "global_steps": engine.global_steps,
@@ -70,9 +81,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
             json.dump(meta, f)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
+    # commit is the durability barrier (async engines wait here); only a
+    # durable checkpoint may become 'latest' — a crash mid-stream must not
+    # leave the pointer aimed at torn bytes
+    ce.commit(tag)
+    if save_latest and jax.process_index() == 0:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(tag)
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
     return True
 
@@ -89,21 +104,20 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             tag = f.read().strip()
     ckpt_dir = os.path.join(load_dir, str(tag))
     state_path = os.path.join(ckpt_dir, "state")
-    if not os.path.isdir(state_path):
+    if not _ckpt_engine(engine).exists(state_path):
         logger.warning(f"checkpoint {ckpt_dir} not found")
         return None, {}
 
     # Restore with the *current* engine shardings — a different mesh/stage
     # than at save time reshards on read (elastic checkpointing,
     # reference ``engine.py:735`` / ``deepspeed/checkpoint``).
-    import orbax.checkpoint as ocp
     target = {
         "params": _abstract(engine.state.params, engine.param_shardings),
         "opt_state": _abstract(engine.state.opt_state, engine.opt_shardings),
         "scaler": jax.tree.map(_abstract_leaf_replicated(engine), engine.state.scaler._asdict()),
         "skipped": _abstract_leaf_replicated(engine)(engine.state.skipped),
     }
-    restored = _checkpointer().restore(state_path, target)
+    restored = _ckpt_engine(engine).load(state_path, target=target)
 
     engine.state.params = restored["params"]
     if load_optimizer_states and not load_module_only:
@@ -148,7 +162,7 @@ def load_params_only(load_dir: str, tag: Optional[str], params, shardings,
     target = {"params": jax.tree.map(
         lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, jnp.float32, sharding=s),
         params, shardings)}
-    restored = _checkpointer().restore(
+    restored = ocp.PyTreeCheckpointer().restore(
         state_path, args=ocp.args.PyTreeRestore(item=target,
                                                 partial_restore=True))["params"]
     if dtype is not None:

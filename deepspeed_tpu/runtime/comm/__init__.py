@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.comm.compressed import (
+    CompressionState, compressed_allreduce, compressed_bytes,
+    init_compression_state)
+
+__all__ = ["compressed_allreduce", "CompressionState",
+           "init_compression_state", "compressed_bytes"]

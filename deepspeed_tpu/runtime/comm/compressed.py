@@ -1,0 +1,102 @@
+"""Compressed (1-bit) allreduce for the onebit optimizer family.
+
+Capability parity with the reference's hand-rolled compressed allreduce
+(``deepspeed/runtime/comm/nccl.py:54`` ``NcclBackend.compressed_allreduce``
+and the MPI/HCCL variants): a two-stage compensated sign compression —
+
+  1. worker side: add the local error-feedback buffer, take the elementwise
+     sign plus one fp32 scale (``||x||/sqrt(n)``), remember the residual;
+  2. exchange: each device all-to-alls its int8 sign chunks so device *d*
+     "serves" chunk *d* — 1 byte/element on the wire instead of 4;
+  3. server side: average the per-worker ``sign·scale`` reconstructions of
+     the served chunk, compensate with a server error buffer, sign+scale
+     again, and all-gather the result (1 byte/element again).
+
+Wire volume per element: 2 bytes (all-to-all + all-gather of int8) vs 8
+bytes for a ring fp32 allreduce — the same 4x the reference reports.
+
+TPU-native design: the whole algorithm is a pure function over
+``jax.lax`` collectives (``all_to_all``/``all_gather``) meant to run inside
+``shard_map`` over the data-parallel mesh axis; the error buffers are the
+caller's state (the engine stores them sharded one-per-device).  No CUDA
+streams, no cupy: XLA schedules the collectives on ICI.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CompressionState(NamedTuple):
+    """Per-device error-feedback buffers (flat, padded)."""
+    worker_error: jax.Array   # [n_padded]     local quantization residual
+    server_error: jax.Array   # [n_padded / world]  residual of the served chunk
+
+
+def padded_size(n: int, world: int) -> int:
+    return -(-n // world) * world
+
+
+def init_compression_state(n: int, world: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Zero-initialized (worker_error, server_error) for a flat size n."""
+    np_ = padded_size(n, world)
+    return (np.zeros((np_,), np.float32), np.zeros((np_ // world,), np.float32))
+
+
+def compressed_bytes(n: int, world: int) -> int:
+    """Bytes this device puts on the wire per call (for the comms logger):
+    int8 all-to-all (n/world to each of world-1 peers) + int8 all-gather of
+    the served chunk + two fp32 scale gathers."""
+    np_ = padded_size(n, world)
+    chunk = np_ // world
+    return (world - 1) * chunk + (world - 1) * chunk + 2 * 4 * (world - 1)
+
+
+def _sign_scale(x):
+    scale = jnp.linalg.norm(x) / jnp.sqrt(jnp.asarray(x.size, jnp.float32))
+    sign = jnp.where(x >= 0, 1, -1).astype(jnp.int8)
+    return sign, scale
+
+
+def compressed_allreduce(x: jax.Array, state: CompressionState,
+                         axis_name: str) -> Tuple[jax.Array, CompressionState]:
+    """Compensated 1-bit mean over ``axis_name`` (call inside shard_map).
+
+    ``x`` is this device's flat fp32 vector (unpadded length); returns the
+    compressed mean (same shape) and the updated error buffers.
+    """
+    world = jax.lax.axis_size(axis_name)
+    n = x.shape[0]
+    n_pad = state.worker_error.shape[0]
+    chunk = n_pad // world
+
+    flat = jnp.zeros((n_pad,), jnp.float32).at[:n].set(x)
+
+    # -- worker compression -------------------------------------------- #
+    compensated = flat + state.worker_error
+    sign, scale = _sign_scale(compensated)
+    new_worker_error = compensated - scale * sign.astype(jnp.float32)
+
+    # -- exchange: device d serves chunk d ----------------------------- #
+    # [world, chunk] rows = my signs of every chunk → after all_to_all rows
+    # = every worker's signs of MY chunk
+    theirs = jax.lax.all_to_all(sign.reshape(world, chunk), axis_name,
+                                split_axis=0, concat_axis=0)      # [w, c] int8
+    scales = jax.lax.all_gather(scale, axis_name)                 # [w]
+
+    recovered = jnp.mean(
+        theirs.astype(jnp.float32) * scales[:, None], axis=0)     # [c]
+
+    # -- server compression of the served chunk ------------------------ #
+    compensated2 = recovered + state.server_error
+    sign2, scale2 = _sign_scale(compensated2)
+    new_server_error = compensated2 - scale2 * sign2.astype(jnp.float32)
+
+    # -- gather every server's compressed chunk ------------------------ #
+    all_signs = jax.lax.all_gather(sign2, axis_name)              # [w, c] int8
+    all_scales = jax.lax.all_gather(scale2, axis_name)            # [w]
+    result = (all_signs.astype(jnp.float32) * all_scales[:, None]).reshape(-1)
+
+    return result[:n], CompressionState(new_worker_error, new_server_error)

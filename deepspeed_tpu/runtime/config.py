@@ -88,6 +88,9 @@ class DeepSpeedCheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
     async_save: bool = False  # TPU-native: orbax async checkpointing
+    # pluggable storage backend (reference checkpoint_engine ABC):
+    # 'orbax' (sharded tensorstore, default) or 'local' (host npz)
+    engine: str = "orbax"
 
 
 class MeshConfig(DeepSpeedConfigModel):
@@ -131,6 +134,22 @@ class EigenvalueConfig(DeepSpeedConfigModel):
     gas_boundary_resolution: int = 1
     layer_name: str = "bert.encoder.layer"
     layer_num: int = 0
+
+
+class QuantizeTrainingConfig(DeepSpeedConfigModel):
+    """MoQ quantize-on-train (reference ``quantize_training`` block,
+    ``runtime/quantize.py``)."""
+    enabled: bool = False
+    quantize_verbose: bool = False
+    quantizer_kernel: bool = False
+    quantize_type: str = "symmetric"        # 'symmetric' | 'asymmetric'
+    rounding: str = "nearest"               # 'nearest' | 'stochastic'
+    quantize_groups: int = 1
+    start_bits: int = 16
+    target_bits: int = 8
+    quantize_period: int = 1000
+    fp16_mixed_quantize: bool = False
+    quantize_change_ratio: float = 0.001
 
 
 class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
@@ -256,6 +275,8 @@ class DeepSpeedConfig:
         self.load_universal_checkpoint = self.checkpoint_config.load_universal
 
         self.eigenvalue_config = EigenvalueConfig(**pd.get(C.EIGENVALUE, {}))
+        self.quantize_training_config = QuantizeTrainingConfig(
+            **pd.get("quantize_training", {}))
         self.pld_config = ProgressiveLayerDropConfig(**pd.get(C.PROGRESSIVE_LAYER_DROP, {}))
 
         self.mesh_config = MeshConfig(**pd.get(C.MESH, {}))

@@ -141,23 +141,31 @@ class DeepSpeedDataSampler:
                   + self.data_parallel_rank) * self.micro_batch_size
         return offset, offset + self.micro_batch_size
 
+    @property
+    def num_micro_batches(self) -> int:
+        """Micro-batches this rank will yield (loader ``__len__`` contract)."""
+        full = self.total_samples // self.global_batch_size
+        if not self.drop_last and self.total_samples % self.global_batch_size:
+            full += 1
+        return full * self.gradient_accumulation_steps
+
     def __iter__(self) -> Iterator[List[int]]:
         """Yields this rank's micro-batches (reference semantics: iterate
         micro-batches; every gas-th batch starts a new global batch).
-        ``drop_last`` governs the final short batch: dropped by default,
-        otherwise yielded truncated."""
+
+        Every yielded micro-batch is FULL-SIZED: SPMD ranks must issue
+        identical programs, so a short final batch cannot be truncated
+        per-rank.  ``drop_last=True`` (default) drops it; ``drop_last=False``
+        fills it by resampling from the eligible pool — shapes, collective
+        schedules and accumulation windows stay uniform on every rank."""
         while self.consumed_samples < self.total_samples:
             remaining = self.total_samples - self.consumed_samples
             if remaining < self.global_batch_size and self.drop_last:
                 return
             batch = self.get_next_global_batch()
-            if remaining < self.global_batch_size:
-                batch = batch[:remaining]
             for m in range(self.gradient_accumulation_steps):
                 s, e = self.get_start_end_idx(m)
-                micro = batch[s:e].tolist()
-                if micro:
-                    yield micro
+                yield batch[s:e].tolist()
 
     # ------------------------------------------------------------------ #
     def state_dict(self) -> dict:

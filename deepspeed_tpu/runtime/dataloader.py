@@ -84,6 +84,17 @@ class DeepSpeedDataLoader:
         self.len = len(dataset) // batch_size if drop_last else -(-len(dataset) // batch_size)
 
     def __len__(self):
+        if self.data_sampler is not None:
+            # the sampler defines how many batches exist; self.len (dataset
+            # size / batch_size) would be a lie on this path
+            n = getattr(self.data_sampler, "num_micro_batches", None)
+            if n is not None:
+                return int(n)
+            if isinstance(self.data_sampler, (list, tuple)):
+                return len(self.data_sampler)
+            raise TypeError(
+                "loader length is defined by the data_sampler; give it a "
+                "num_micro_batches attribute (or pass a list of index batches)")
         return self.len
 
     def set_epoch(self, epoch: int):

@@ -226,6 +226,44 @@ class DeepSpeedEngine:
 
         self._data_post_process_func = None
 
+        # compression-in-training (reference compression/compress.py:95):
+        # technique bindings over the param tree + activation schedule
+        self.compression_scheduler = None
+        self._compression_spec = None
+        self._compression_enabled = {}
+        if self._config.compression_config:
+            from deepspeed_tpu.compression import init_compression
+            n_head = getattr(getattr(model, "cfg", None), "n_head", None)
+            self._compression_spec = init_compression(
+                self.state.params,
+                {"compression_training": self._config.compression_config},
+                num_heads=n_head)
+            self.compression_scheduler = self._compression_spec.scheduler
+            self._compression_enabled = (
+                self.compression_scheduler.check_all_modules(0))
+
+        # MoQ quantize-on-train (reference runtime/quantize.py) + block
+        # eigenvalues (runtime/eigenvalue.py) for curvature-aware periods
+        self.quantizer = None
+        self.eigenvalue = None
+        qc = self._config.quantize_training_config
+        if qc.enabled:
+            from deepspeed_tpu.runtime.quantize import Quantizer
+            self.quantizer = Quantizer(
+                q_groups=qc.quantize_groups, q_mixed_fp16=qc.fp16_mixed_quantize,
+                q_change_ratio=qc.quantize_change_ratio, q_type=qc.quantize_type,
+                q_rounding=qc.rounding, q_verbose=qc.quantize_verbose,
+                q_period=qc.quantize_period, q_start_bits=qc.start_bits,
+                q_target_bits=qc.target_bits)
+        if self._config.eigenvalue_config.enabled:
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+            ec = self._config.eigenvalue_config
+            self.eigenvalue = Eigenvalue(
+                verbose=ec.verbose, max_iter=ec.max_iter, tol=ec.tol,
+                stability=ec.stability,
+                gas_boundary_resolution=ec.gas_boundary_resolution,
+                layer_name=ec.layer_name, layer_num=ec.layer_num)
+
         # ---- compiled programs (built lazily per batch structure) ------ #
         self._grad_step = None
         self._eval_step = None
@@ -293,9 +331,7 @@ class DeepSpeedEngine:
         new = None if keep >= max_v else int(keep)
         if cfg.ltd_keep != new:
             self.module.cfg = _dc.replace(cfg, ltd_keep=new)
-            self._grad_step = None   # re-trace with the new static keep
-            self._eval_step = None
-            self._fused_step = None
+            self._invalidate_loss_programs()
 
     def set_data_post_process_func(self, fn):
         """Reference parity (engine.py): user hook applied to each batch
@@ -443,14 +479,136 @@ class DeepSpeedEngine:
                                "zero_allow_untested_optimizer to silence")
         else:
             name = self._config.optimizer_name or "adam"
-            tx = get_optimizer(name, dict(self._config.optimizer_params),
-                               lr_schedule=self._schedule_fn)
+            opt_params = dict(self._config.optimizer_params)
+            self._configure_onebit_comm(name, opt_params)
+            tx = get_optimizer(name, opt_params, lr_schedule=self._schedule_fn)
         self.tx = tx
         opt_shapes = jax.eval_shape(tx.init, self.state.params)
         self.opt_shardings = self.zero_policy.opt_shardings(opt_shapes, self.state.params,
                                                            getattr(self, "_logical_specs", None))
         self.opt_shardings = self._maybe_offload(self.opt_shardings, opt_shapes)
         self.state.opt_state = jax.jit(tx.init, out_shardings=self.opt_shardings)(self.state.params)
+
+    def _configure_onebit_comm(self, name: str, opt_params: dict):
+        """Enable the compensated 1-bit gradient allreduce for the onebit
+        optimizer family (reference ``runtime/comm/nccl.py:54``).
+
+        Active when the mesh is pure data-parallel with >1 device: gradients
+        are then the only inter-chip exchange, and after ``freeze_step``
+        they travel as int8 sign + scale through ``compressed_allreduce``
+        instead of the fp32 XLA psum.  Non-DP axes (tensor/pipe/seq/fsdp)
+        reshard parameters, which the compressed exchange does not cover —
+        those configs keep exact reduction (warned once)."""
+        self._onebit_comm = None
+        if name not in ("onebitadam", "onebitlamb", "zerooneadam"):
+            return
+        dp = int(self.mesh.shape["data"])
+        pure_dp = all(int(self.mesh.shape[a]) == 1
+                      for a in self.mesh.axis_names if a != "data")
+        if dp <= 1 or not pure_dp:
+            if dp > 1:
+                log_dist("onebit optimizer: mesh has non-data axes — "
+                         "gradient exchange stays uncompressed (exact)",
+                         ranks=[0])
+            return
+        freeze = int(opt_params.get("freeze_step",
+                                    opt_params.get("var_freeze_step", 100)))
+        opt_params["comm_compression"] = True
+        betas = opt_params.get("betas", (0.9, 0.999))
+        self._onebit_comm = {"freeze_step": freeze, "world": dp,
+                             "b1": float(betas[0])}
+        self._onebit_errors = None
+        self._grad_step_local = None
+        self._compress_step = None
+        self._acc_step_local = None
+        log_dist(f"onebit optimizer: compressed gradient allreduce active "
+                 f"after step {freeze} over {dp} data-parallel devices",
+                 ranks=[0])
+
+    # -- compressed 1-bit gradient exchange ----------------------------- #
+    def _onebit_active(self) -> bool:
+        return (getattr(self, "_onebit_comm", None) is not None
+                and self.global_steps >= self._onebit_comm["freeze_step"])
+
+    def _ensure_onebit_errors(self):
+        if self._onebit_errors is not None:
+            return
+        from deepspeed_tpu.runtime.comm.compressed import (init_compression_state,
+                                                           padded_size)
+        world = self._onebit_comm["world"]
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.state.params))
+        n_pad = padded_size(n, world)
+        we, se = init_compression_state(n, world)
+        sh = NamedSharding(self.mesh, PartitionSpec("data"))
+        self._onebit_errors = (
+            jax.device_put(np.tile(we, (world, 1)), sh),
+            jax.device_put(np.tile(se, (world, 1)), sh))
+        self._onebit_n = n
+        self._onebit_npad = n_pad
+
+    def _build_grad_step_local(self, batch):
+        """Per-device (UNREDUCED) gradients under shard_map: the exchange is
+        deferred to the compressed step at the gas boundary."""
+        axes = mesh_lib.BATCH_AXES
+        bspec = jax.tree.map(
+            lambda x: PartitionSpec(axes) if getattr(x, "ndim", 0) >= 1
+            else PartitionSpec(), batch)
+        pspec = jax.tree.map(lambda _: PartitionSpec(), self.state.params)
+
+        def local(params, batch, rng, scale):
+            with mesh_lib.manual_sharding():
+                loss, grads = self._value_and_grad(params, batch, rng, scale)
+            loss = jax.lax.pmean(loss, "data")
+            grads = jax.tree.map(lambda g: g[None], grads)   # [1(dp), ...]
+            return loss, grads
+
+        gspec = jax.tree.map(lambda _: PartitionSpec("data"), self.state.params)
+        fn = jax.shard_map(local, mesh=self.mesh,
+                           in_specs=(pspec, bspec, PartitionSpec(), PartitionSpec()),
+                           out_specs=(PartitionSpec(), gspec), check_vma=False)
+        return jax.jit(fn)
+
+    def _build_compress_step(self):
+        """Momentum formation + compensated 1-bit allreduce, the reference
+        optimizer.step exchange: per device
+        ``m_local = b1·m + (1-b1)·g_local``; the compressed mean of
+        ``m_local`` is the new shared momentum the optimizer consumes."""
+        from deepspeed_tpu.runtime.comm.compressed import (CompressionState,
+                                                           compressed_allreduce)
+        leaves = jax.tree.leaves(self.state.params)
+        shapes = [p.shape for p in leaves]
+        sizes = [int(np.prod(s)) for s in shapes]
+        treedef = jax.tree.structure(self.state.params)
+        b1 = self._onebit_comm["b1"]
+        gas = self._grad_accum_divisor()
+
+        def compress(local_grads, mu, werr, serr, scale):
+            inv = 1.0 / (scale * gas)       # undo loss scaling + gas summing
+            g = jnp.concatenate(
+                [x[0].reshape(-1).astype(jnp.float32) * inv
+                 for x in jax.tree.leaves(local_grads)])
+            m_prev = jnp.concatenate(
+                [x.reshape(-1).astype(jnp.float32) for x in jax.tree.leaves(mu)])
+            m_local = b1 * m_prev + (1 - b1) * g
+            out, st = compressed_allreduce(
+                m_local, CompressionState(werr[0], serr[0]), "data")
+            parts = []
+            off = 0
+            for shape, size in zip(shapes, sizes):
+                parts.append(out[off:off + size].reshape(shape))
+                off += size
+            m_new = jax.tree.unflatten(treedef, parts)
+            return m_new, st.worker_error[None], st.server_error[None]
+
+        gspec = jax.tree.map(lambda _: PartitionSpec("data"), self.state.params)
+        rspec = jax.tree.map(lambda _: PartitionSpec(), self.state.params)
+        fn = jax.shard_map(
+            compress, mesh=self.mesh,
+            in_specs=(gspec, rspec, PartitionSpec("data"), PartitionSpec("data"),
+                      PartitionSpec()),
+            out_specs=(rspec, PartitionSpec("data"), PartitionSpec("data")),
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 2, 3))
 
     def _maybe_offload(self, shardings, opt_shapes):
         """ZeRO-Offload: place optimizer state in host memory
@@ -485,12 +643,63 @@ class DeepSpeedEngine:
             lambda x: x.astype(self.compute_dtype)
             if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x, batch)
 
+    def _invalidate_loss_programs(self):
+        """Drop every compiled program that bakes the loss path (schedule
+        flips: compression/MoQ/LTD change the traced computation)."""
+        self._grad_step = None
+        self._eval_step = None
+        self._fused_step = None
+        if getattr(self, "_grad_step_local", None) is not None:
+            self._grad_step_local = None
+
+    def _eigenvalue_factor(self) -> float:
+        """MoQ curvature factor (reference engine.py:2013-2017): every
+        ``gas_boundary_resolution`` steps, power-iterate the loss Hessian
+        on the last micro-batch; high curvature stretches the quantization
+        period.  Opt-in via the ``eigenvalue`` config block."""
+        if self.eigenvalue is None or getattr(self, "_last_batch", None) is None:
+            return getattr(self, "_eig_factor", 1.0)
+        res = max(1, self.eigenvalue.gas_boundary_resolution)
+        if self.global_steps % res != 0:
+            return getattr(self, "_eig_factor", 1.0)
+        batch = self._last_batch
+        rng = jax.random.PRNGKey(0)
+
+        def loss_fn(p):
+            cast = jax.tree.map(lambda x: x.astype(jnp.float32), p)
+            out = self._loss_fn(cast, batch, rng, False)
+            loss = out[0] if isinstance(out, tuple) else out
+            return loss.astype(jnp.float32)
+
+        try:
+            eig = abs(self.eigenvalue.compute_eigenvalue(
+                loss_fn, self.state.params, rng))
+        except Exception as e:
+            logger.warning(f"eigenvalue computation failed: {e}")
+            return getattr(self, "_eig_factor", 1.0)
+        self._eig_max = max(getattr(self, "_eig_max", 0.0), eig)
+        self._eig_factor = 1.0 + (eig / self._eig_max if self._eig_max else 0.0)
+        return self._eig_factor
+
+    def _compress_params(self, params, rng):
+        """Apply schedule-active compression techniques + MoQ quantization
+        to the cast params (inside the jitted step; pure, STE)."""
+        if (self._compression_spec is not None
+                and any(self._compression_enabled.values())):
+            params = self._compression_spec.transform(
+                params, dict(self._compression_enabled),
+                jax.random.fold_in(rng, 31))
+        if self.quantizer is not None:
+            params = self.quantizer.qdq(params, jax.random.fold_in(rng, 32))
+        return params
+
     def _value_and_grad(self, params, batch, rng, scale):
         batch = self._cast_batch(batch)
         params = self._device_view(params, self.param_shardings)
 
         def scaled_loss(p):
             cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), p)
+            cast = self._compress_params(cast, rng)
             out = self._loss_fn(cast, batch, rng, True)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
             return (loss.astype(jnp.float32) * scale, (loss, aux))
@@ -512,6 +721,7 @@ class DeepSpeedEngine:
         def eval_step(params, batch, rng):
             params = self._device_view(params, self.param_shardings)
             cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), params)
+            cast = self._compress_params(cast, rng)
             out = self._loss_fn(cast, self._cast_batch(batch), rng, False)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
             return loss
@@ -525,28 +735,36 @@ class DeepSpeedEngine:
 
         return acc
 
-    def _apply_updates(self, params, opt_state, grads, scaler, skipped):
+    def _apply_updates(self, params, opt_state, grads, scaler, skipped,
+                       momentum_mode=False):
         """One optimizer step: unscale, clip, overflow-gate, update, rescale.
 
         The reference splits this across ``_take_model_step:1924`` and each
         optimizer's ``step``; here it is a single XLA program with donated
-        buffers.
+        buffers.  ``momentum_mode`` (post-freeze 1-bit path): ``grads`` are
+        the already-unscaled compressed momentum — no unscale, no clip
+        (clipping a sign-compressed momentum would distort the compensated
+        exchange), no overflow gate.
         """
         params = self._device_view(params, self.param_shardings)
         opt_state = self._device_view(opt_state, self.opt_shardings)
-        # grads arrive as a SUM over gas micro-steps on the standard path;
-        # the PipelineEngine computes a mean inside its program and sets the
-        # divisor to 1 (a second division would shrink updates gas-fold).
-        inv = 1.0 / (scaler.scale * self._grad_accum_divisor())
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
-
-        overflow = has_overflow(grads) if self.fp16_enabled else jnp.asarray(False)
+        if momentum_mode:
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            overflow = jnp.asarray(False)
+        else:
+            # grads arrive as a SUM over gas micro-steps on the standard
+            # path; the PipelineEngine computes a mean inside its program and
+            # sets the divisor to 1 (a second division would shrink updates
+            # gas-fold).
+            inv = 1.0 / (scaler.scale * self._grad_accum_divisor())
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+            overflow = has_overflow(grads) if self.fp16_enabled else jnp.asarray(False)
 
         # global grad norm (across every shard — XLA inserts the reductions)
         sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
         grad_norm = jnp.sqrt(sq)
         clip = self.gradient_clipping()
-        if clip and clip > 0:
+        if clip and clip > 0 and not momentum_mode:
             factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
             grads = jax.tree.map(lambda g: g * factor, grads)
 
@@ -566,14 +784,15 @@ class DeepSpeedEngine:
         stats = {"grad_norm": grad_norm, "overflow": overflow, "loss_scale": new_scaler.scale}
         return new_params, new_opt, new_scaler, new_skipped, stats
 
-    def _build_apply_step(self):
+    def _build_apply_step(self, momentum_mode=False):
         repl = NamedSharding(self.mesh, PartitionSpec())
         out_shardings = (self.param_shardings, self.opt_shardings, jax.tree.map(lambda _: repl, self.state.scaler),
                          repl, {"grad_norm": repl, "overflow": repl, "loss_scale": repl})
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4), out_shardings=out_shardings)
         def apply_step(params, opt_state, acc, scaler, skipped):
-            return self._apply_updates(params, opt_state, acc, scaler, skipped)
+            return self._apply_updates(params, opt_state, acc, scaler, skipped,
+                                       momentum_mode=momentum_mode)
 
         return apply_step
 
@@ -665,16 +884,29 @@ class DeepSpeedEngine:
         else:
             batch = inputs if len(inputs) != 1 else inputs[0]
         batch = self._place_batch(batch)
+        if self.eigenvalue is not None:
+            self._last_batch = batch     # MoQ curvature probes reuse it
         if self.flops_profiler:
             self.flops_profiler.start_profile(
                 batch, num_micro_steps=self.gradient_accumulation_steps())
         self.timers(FORWARD_MICRO_TIMER).start(sync=False)
 
         if self._in_training_mode:
-            if self._grad_step is None:
-                self._grad_step = self._build_grad_step()
-            loss, grads = self._grad_step(self.state.params, batch, self._next_rng(),
-                                          self.state.scaler.scale)
+            if self._onebit_active():
+                # post-freeze 1-bit path: gradients stay per-device here and
+                # travel compressed at the gas boundary (step())
+                if self._grad_step_local is None:
+                    self._grad_step_local = self._build_grad_step_local(batch)
+                loss, grads = self._grad_step_local(
+                    self.state.params, batch, self._next_rng(),
+                    self.state.scaler.scale)
+                self._grads_are_local = True
+            else:
+                if self._grad_step is None:
+                    self._grad_step = self._build_grad_step()
+                loss, grads = self._grad_step(self.state.params, batch, self._next_rng(),
+                                              self.state.scaler.scale)
+                self._grads_are_local = False
             self._cached_grads = grads
             self._cached_loss = loss
         else:
@@ -696,6 +928,12 @@ class DeepSpeedEngine:
         if self.state.grad_acc is None:
             # grads are already fp32 and placed by the grad_step out_shardings
             self.state.grad_acc = self._cached_grads
+        elif getattr(self, "_grads_are_local", False):
+            if self._acc_step_local is None:
+                self._acc_step_local = jax.jit(
+                    lambda a, g: jax.tree.map(jnp.add, a, g), donate_argnums=(0,))
+            self.state.grad_acc = self._acc_step_local(self.state.grad_acc,
+                                                       self._cached_grads)
         else:
             if self._acc_step is None:
                 self._acc_step = self._build_acc_step()
@@ -717,12 +955,59 @@ class DeepSpeedEngine:
         """Optimizer step at GAS boundaries (reference ``engine.py:1989``)."""
         self.timers(STEP_MICRO_TIMER).start(sync=False)
         if self.is_gradient_accumulation_boundary() and self.state.grad_acc is not None:
-            if self._apply_step is None:
-                self._apply_step = self._build_apply_step()
+            momentum_mode = False
+            if getattr(self, "_grads_are_local", False):
+                if self.fp16_enabled:
+                    # overflow must be caught BEFORE the momentum exchange:
+                    # compressing an inf gradient would poison the shared
+                    # momentum and both error buffers unrecoverably (the
+                    # reference likewise checks overflow pre-compression)
+                    if getattr(self, "_has_overflow_fn", None) is None:
+                        self._has_overflow_fn = jax.jit(has_overflow)
+                        self._update_scale_fn = jax.jit(update_scale)
+                    ovf = bool(self._has_overflow_fn(self.state.grad_acc))
+                    if ovf:
+                        self.state.scaler = self._update_scale_fn(
+                            self.state.scaler, jnp.asarray(True))
+                        self.state.skipped = self.state.skipped + 1
+                        self.state.grad_acc = None
+                        self._grads_are_local = False
+                        stats = {"grad_norm": jnp.asarray(0.0),
+                                 "overflow": jnp.asarray(True),
+                                 "loss_scale": self.state.scaler.scale}
+                        self._step_stats = stats
+                        self._advance_step_counters(stats)
+                        self.timers(STEP_MICRO_TIMER).stop(sync=False)
+                        return
+                # the only inter-chip exchange of the step: int8 sign+scale
+                # of the compensated local momentum
+                self._ensure_onebit_errors()
+                if self._compress_step is None:
+                    self._compress_step = self._build_compress_step()
+                m_new, we, se = self._compress_step(
+                    self.state.grad_acc, self.state.opt_state.mu,
+                    *self._onebit_errors, self.state.scaler.scale)
+                self._onebit_errors = (we, se)
+                self.state.grad_acc = m_new
+                self._grads_are_local = False
+                momentum_mode = True
+                if self.comms_logger is not None:
+                    from deepspeed_tpu.runtime.comm.compressed import compressed_bytes
+                    self.comms_logger.append(
+                        "compressed_allreduce",
+                        compressed_bytes(self._onebit_n, self._onebit_comm["world"]))
+            if momentum_mode:
+                if getattr(self, "_apply_step_ob", None) is None:
+                    self._apply_step_ob = self._build_apply_step(momentum_mode=True)
+                apply = self._apply_step_ob
+            else:
+                if self._apply_step is None:
+                    self._apply_step = self._build_apply_step()
+                apply = self._apply_step
             (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped,
-             stats) = self._apply_step(self.state.params, self.state.opt_state,
-                                       self.state.grad_acc, self.state.scaler,
-                                       self.state.skipped)
+             stats) = apply(self.state.params, self.state.opt_state,
+                            self.state.grad_acc, self.state.scaler,
+                            self.state.skipped)
             self.state.grad_acc = None
             self._step_stats = stats
             self._advance_step_counters(stats)
@@ -748,6 +1033,17 @@ class DeepSpeedEngine:
             if self.random_ltd_scheduler is not None:
                 self._apply_ltd_keep(
                     self.random_ltd_scheduler.update_seq(self.global_steps))
+            if self.compression_scheduler is not None:
+                flags = self.compression_scheduler.check_all_modules(
+                    self.global_steps)
+                if flags != self._compression_enabled:
+                    self._compression_enabled = flags
+                    self._invalidate_loss_programs()
+            if self.quantizer is not None:
+                # MoQ schedule (reference engine.py:2013-2017 feeds block
+                # eigenvalues in; a precision switch re-traces)
+                if self.quantizer.step(self._eigenvalue_factor()):
+                    self._invalidate_loss_programs()
             if self.flops_profiler is not None:
                 self.flops_profiler.stop_profile()
                 fc = self._config.flops_profiler_config
@@ -760,6 +1056,23 @@ class DeepSpeedEngine:
         """One full optimizer step over GAS micro-batches in a single XLA
         program.  ``batch`` leaves must have leading dim [gas, micro, ...],
         or ``data_iter`` yields GAS micro-batches."""
+        if getattr(self, "_onebit_comm", None) is not None:
+            # the fused program reduces gradients exactly, which would hand
+            # the post-freeze onebit optimizer raw grads where it expects
+            # the compressed momentum — route through the micro-step path,
+            # whose step() performs the compressed exchange
+            self.tput_timer.start()
+            losses = []
+            for _ in range(self.gradient_accumulation_steps()):
+                mb = (next(data_iter) if batch is None
+                      else jax.tree.map(lambda x: x[len(losses)], batch))
+                mb = mb if isinstance(mb, (tuple, list)) else (mb,)
+                loss = self.forward(*mb)
+                self.backward(loss)
+                losses.append(loss)
+            self.step()
+            self.tput_timer.stop(global_step=True)
+            return sum(jnp.asarray(losses)) / len(losses)
         if batch is None:
             micro_batches = [next(data_iter) for _ in range(self.gradient_accumulation_steps())]
             batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micro_batches)
@@ -877,6 +1190,22 @@ class DeepSpeedEngine:
                 self.monitor.write_events(events)
         if self.wall_clock_breakdown_enabled and spp and self.global_steps % spp == 0:
             self.timers.log([FORWARD_MICRO_TIMER, BACKWARD_MICRO_TIMER, STEP_MICRO_TIMER])
+        # autotuning experiment mode: export the metric the tuner ranks on
+        # (reference writes it via the autotuning model-info/metrics files)
+        metric_path = os.environ.get("DS_AUTOTUNING_METRIC_PATH")
+        if metric_path and spp and self.global_steps % spp == 0:
+            from deepspeed_tpu.autotuning.scheduler import write_metrics
+            tput = self.tput_timer.avg_samples_per_sec()
+            metrics = {"throughput": tput, "global_steps": self.global_steps}
+            if self.flops_profiler is not None and self.flops_profiler.flops_per_step:
+                lat = max(self.flops_profiler.latency, 1e-9)
+                metrics["FLOPS_per_gpu"] = (
+                    self.flops_profiler.flops_per_step / lat / jax.device_count())
+                metrics["latency"] = lat
+            try:
+                write_metrics(metric_path, metrics)
+            except OSError as e:
+                logger.warning(f"autotuning metric write failed: {e}")
 
     # ------------------------------------------------------------------ #
     # Dataloader (reference engine.deepspeed_io:1560)

@@ -29,13 +29,20 @@ class OneBitAdamState(NamedTuple):
 
 
 def onebit_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
-                freeze_step=100, use_trust_ratio=False) -> optax.GradientTransformation:
+                freeze_step=100, use_trust_ratio=False,
+                comm_compression=False) -> optax.GradientTransformation:
     """1-bit Adam (reference ``onebit/adam.py:OnebitAdam:13``).
 
     Before ``freeze_step``: exact Adam.  After: variance frozen; the update
     direction is the compensated 1-bit momentum sign times its mean
     magnitude (error feedback keeps the quantization unbiased over time).
     ``use_trust_ratio`` turns this into 1-bit LAMB's layerwise scaling.
+
+    ``comm_compression=True`` means the engine already exchanges gradients
+    through the compensated 1-bit allreduce (``runtime/comm/compressed.py``)
+    — the local momentum quantization is then skipped (quantizing twice
+    would double the error with no wire saving); the optimizer contributes
+    the frozen-variance Adam math, as the reference's server-side step does.
     """
 
     def init_fn(params):
@@ -45,9 +52,20 @@ def onebit_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
 
     def update_fn(updates, state, params=None):
         count = state.count + 1
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
         in_warmup = count <= freeze_step
+        if comm_compression:
+            # engine contract: during warmup ``updates`` are exact gradients;
+            # after the freeze they are the compensated-compressed momentum
+            # m_t itself (formed and exchanged in the engine's compress step,
+            # reference optimizer.step's compressed_allreduce of m)
+            mu = jax.tree.map(
+                lambda m, u: jnp.where(in_warmup, b1 * m + (1 - b1) * u, u),
+                state.mu, updates)
+        else:
+            mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
         # variance only updates during warmup (frozen afterwards)
+        # (in comm_compression mode post-freeze, ``updates`` are momentum,
+        # but nu is frozen then anyway — the where keeps warmup exact)
         nu = jax.tree.map(
             lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g), v),
             state.nu, updates)
@@ -66,6 +84,11 @@ def onebit_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
             return quant / (jnp.sqrt(v / bc2) + eps), new_e
 
         def choose(m, v, e):
+            if comm_compression:
+                # grads arrived through the compressed allreduce; after the
+                # freeze the variance is held, exactly the reference's
+                # post-warmup server math
+                return adam_dir(m, v), e
             d_warm = adam_dir(m, v)
             d_comp, new_e = compressed_dir(m, v, e)
             d = jnp.where(in_warmup, d_warm, d_comp)
@@ -97,20 +120,22 @@ def onebit_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
 
 
 def zero_one_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
-                  var_freeze_step=100, var_update_scaler=16, **_):
+                  var_freeze_step=100, var_update_scaler=16,
+                  comm_compression=False, **_):
     """0/1 Adam (reference ``onebit/zoadam.py:ZeroOneAdam:13``): like 1-bit
     Adam but the variance keeps updating on a geometric cadence; approximated
     here with the same freeze point (cadence policies are a host-side detail
     the XLA program can't cheaply express)."""
     return onebit_adam(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
-                       freeze_step=var_freeze_step)
+                       freeze_step=var_freeze_step, comm_compression=comm_compression)
 
 
 def get_onebit_optimizer(name: str, params: dict, lr):
     betas = params.get("betas", (0.9, 0.999))
     kwargs = dict(b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-8),
                   weight_decay=params.get("weight_decay", 0.0),
-                  freeze_step=params.get("freeze_step", 100))
+                  freeze_step=params.get("freeze_step", 100),
+                  comm_compression=params.get("comm_compression", False))
     if name == "onebitadam":
         return onebit_adam(lr, **kwargs)
     if name == "onebitlamb":

@@ -1,0 +1,107 @@
+"""MoQ — mixture-of-quantization (quantize-on-train).
+
+Reference: ``deepspeed/runtime/quantize.py`` (``Quantizer``): during
+training, weights are progressively quantized from ``start_bits`` down to
+``target_bits``, halving precision every ``quantize_period`` steps
+(period doubling after each switch); ``quantize_ratio`` mixes the
+quantized and fp copies; block eigenvalues (``runtime/eigenvalue.py``)
+can stretch each layer's period by curvature.  Functional redesign: the
+Quantizer owns the schedule state host-side and exposes a pure
+``qdq(params, rng)`` transform the engine jits; precision switches
+re-trace (bounded by the number of bit widths).
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.basic_ops import quantize_weight
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class Quantizer:
+
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False,
+                 q_change_ratio: float = 0.001, q_type: str = "symmetric",
+                 q_rounding: str = "nearest", q_verbose: bool = False,
+                 q_period: int = 1000, q_start_bits: int = 16,
+                 q_target_bits: int = 8, use_quantizer_kernel: bool = False,
+                 layer_num: int = 0):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.q_verbose = q_verbose
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.period = q_period
+        self.layer_num = layer_num
+        self.current_bits = q_start_bits
+        self.quantize_ratio = 0.0 if q_mixed_fp16 else 1.0
+        self.qsteps = 0
+        self._next_switch = q_period
+        self._ratio_bucket = round(self.quantize_ratio / 0.05)
+
+    # -- schedule (reference Quantizer.step/any_precision_switch) -------- #
+    def any_precision_switch(self) -> bool:
+        return self.current_bits > self.q_target_bits
+
+    def step(self, eigenvalue_factor: float = 1.0) -> bool:
+        """Advance one optimizer step; returns True when the precision
+        switched (callers re-trace their jitted transform then).
+        ``eigenvalue_factor`` > 1 stretches the period (high curvature →
+        quantize later, the MoQ eigenvalue mechanism)."""
+        self.qsteps += 1
+        changed = False
+        if self.q_mixed_fp16 and self.quantize_ratio < 1.0:
+            self.quantize_ratio = min(1.0, self.quantize_ratio + self.q_change_ratio)
+            # the ratio is baked into the compiled step; re-trace in 5%
+            # buckets so the anneal is visible without per-step recompiles
+            bucket = round(self.quantize_ratio / 0.05)
+            if bucket != self._ratio_bucket:
+                self._ratio_bucket = bucket
+                changed = True
+        if (self.any_precision_switch()
+                and self.qsteps >= self._next_switch * eigenvalue_factor):
+            self.current_bits = max(self.q_target_bits, self.current_bits // 2)
+            self._next_switch += self.period
+            self.period *= 2      # reference doubles the period per switch
+            log_dist(f"MoQ: precision -> {self.current_bits} bits at step "
+                     f"{self.qsteps}", ranks=[0])
+            changed = True
+        return changed
+
+    # -- the pure transform --------------------------------------------- #
+    def qdq(self, params, rng: Optional[jax.Array] = None):
+        """Quantize-dequantize every >=2-D weight at the current precision,
+        mixed with the fp copy by ``quantize_ratio`` (jit-safe; STE)."""
+        if self.current_bits >= 16:
+            return params
+        bits = self.current_bits
+        ratio = self.quantize_ratio
+
+        def one(w):
+            if not hasattr(w, "ndim") or w.ndim < 2:
+                return w
+            q = quantize_weight(w, bits, quant_type=self.q_type,
+                                rounding=self.q_rounding,
+                                groups=self.q_groups, rng=rng)
+            if ratio >= 1.0:
+                return q
+            return (ratio * q + (1.0 - ratio) * w).astype(w.dtype)
+
+        return jax.tree.map(one, params)
+
+    def state_dict(self) -> Dict:
+        return {"current_bits": self.current_bits, "qsteps": self.qsteps,
+                "quantize_ratio": self.quantize_ratio, "period": self.period,
+                "next_switch": self._next_switch}
+
+    def load_state_dict(self, state: Dict):
+        self.current_bits = state["current_bits"]
+        self.qsteps = state["qsteps"]
+        self.quantize_ratio = state["quantize_ratio"]
+        self.period = state["period"]
+        self._next_switch = state["next_switch"]

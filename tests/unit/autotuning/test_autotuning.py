@@ -1,0 +1,187 @@
+"""Autotuning subsystem tests (reference ``tests/unit/autotuning/``):
+tuner search behavior, memory-model pruning, experiment scheduling, and
+the end-to-end tune() flow with a synthetic cost function."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, GridSearchTuner,
+                                      ModelBasedTuner, RandomTuner,
+                                      ResourceManager, RidgeCostModel)
+from deepspeed_tpu.autotuning.utils import (dict_to_feature, flatten,
+                                            gen_combinations)
+
+
+class TestUtils:
+    def test_gen_combinations_nested(self):
+        space = {"a": [1, 2], "b": {"c": [3, 4], "d": 5}}
+        combos = gen_combinations(space)
+        assert len(combos) == 4
+        assert {"a": 1, "b": {"c": 3, "d": 5}} in combos
+
+    def test_flatten(self):
+        assert flatten({"a": {"b": 1}, "c": 2}) == {"a_b": 1, "c": 2}
+
+    def test_feature_vector(self):
+        f = dict_to_feature({"x": 2, "y": True, "z": "cpu"}, ["x", "y", "z"])
+        assert f[0] == 2.0 and f[1] == 1.0 and 0 <= f[2] <= 1
+
+
+def _exps():
+    # metric peaks at mbs=16, stage=1
+    out = []
+    for stage in (0, 1, 2):
+        for mbs in (1, 2, 4, 8, 16, 32):
+            out.append({"zero_optimization": {"stage": stage},
+                        "train_micro_batch_size_per_gpu": mbs})
+    return out
+
+
+def _metric(exp):
+    mbs = exp["train_micro_batch_size_per_gpu"]
+    stage = exp["zero_optimization"]["stage"]
+    if mbs > 16:
+        return None                     # OOM
+    return 100 - (mbs - 16) ** 2 / 4 - 3 * abs(stage - 1)
+
+
+class TestTuners:
+    def test_grid_exhaustive_finds_best(self):
+        tuner = GridSearchTuner(_exps(), _metric)
+        best, val = tuner.tune(n_trials=100)
+        assert best["train_micro_batch_size_per_gpu"] == 16
+        assert best["zero_optimization"]["stage"] == 1
+        assert val == 100
+
+    def test_random_samples_all_without_repeat(self):
+        seen = []
+        tuner = RandomTuner(_exps(), lambda e: (seen.append(e), _metric(e))[1],
+                            seed=3)
+        tuner.tune(n_trials=100)
+        assert len(seen) == len(_exps())
+        assert len({json.dumps(e, sort_keys=True) for e in seen}) == len(seen)
+
+    def test_early_stopping(self):
+        calls = []
+        tuner = GridSearchTuner(_exps(), lambda e: (calls.append(e), 1.0)[1])
+        tuner.tune(early_stopping=3)
+        # first exp sets best; 3 non-improving runs later it stops
+        assert len(calls) == 4
+
+    def test_model_based_beats_random_sample_efficiency(self):
+        evals = []
+        tuner = ModelBasedTuner(_exps(), lambda e: (evals.append(e), _metric(e))[1],
+                                warmup=4, seed=0)
+        best, val = tuner.tune(n_trials=10)
+        assert val is not None and val >= 90       # near-peak in 10 trials
+
+    def test_failed_runs_are_skipped(self):
+        tuner = GridSearchTuner(_exps(), _metric)
+        best, _ = tuner.tune(n_trials=100)
+        assert best["train_micro_batch_size_per_gpu"] <= 16  # OOMs not chosen
+
+    def test_ridge_cost_model_orders_quadratic(self):
+        m = RidgeCostModel()
+        xs = [[x, x * x] for x in range(10)]
+        ys = [100 - (x - 6) ** 2 for x in range(10)]
+        m.fit(xs, ys)
+        preds = m.predict([[4, 16], [6, 36], [9, 81]])
+        assert preds[1] > preds[0] and preds[1] > preds[2]
+
+
+class TestAutotuner:
+    BASE = {"train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "autotuning": {"enabled": True, "metric": "throughput",
+                           "micro_batch_sizes": [1, 2, 4, 8, 16, 32]}}
+
+    def test_end_to_end_tune_with_synthetic_metric(self, tmp_path):
+        at = Autotuner(self.BASE, run_fn=_metric, dp_world=8,
+                       results_dir=str(tmp_path))
+        best = at.tune()
+        assert best["train_micro_batch_size_per_gpu"] == 16
+        assert best["zero_optimization"]["stage"] == 1
+        assert best["train_batch_size"] == 16 * 8
+        opt = json.load(open(tmp_path / "ds_config_optimal.json"))
+        assert opt == best
+        assert (tmp_path / "summary.txt").exists()
+
+    def test_memory_model_prunes_stages(self, tmp_path):
+        # 100M params, 1 GiB device: stage 0 needs 18 bytes/param = 1.8 GB
+        at = Autotuner(self.BASE, run_fn=_metric, dp_world=8,
+                       model_info={"num_params": 100_000_000},
+                       device_memory_bytes=1 << 30,
+                       results_dir=str(tmp_path))
+        stages = at._feasible_stages()
+        assert 0 not in stages
+        assert 3 in stages
+        # memory estimate is monotonically decreasing in stage
+        mems = [at.get_instantiation_memory_required_per_device(s)
+                for s in (0, 1, 2, 3)]
+        assert mems == sorted(mems, reverse=True)
+
+    def test_stage3_space_includes_offload(self):
+        at = Autotuner(self.BASE, run_fn=_metric)
+        exps = at._experiments(3)
+        offloads = {json.dumps(e["zero_optimization"].get("offload_param"))
+                    for e in exps}
+        assert "null" in offloads and len(offloads) == 2
+
+    def test_max_train_batch_size_limits_exps(self):
+        cfg = dict(self.BASE)
+        cfg["autotuning"] = dict(cfg["autotuning"], max_train_batch_size=8)
+        at = Autotuner(cfg, run_fn=_metric, dp_world=4)
+        for e in at._experiments(0):
+            assert e["train_batch_size"] <= 8
+
+
+class TestResourceManager:
+    def test_subprocess_experiment_roundtrip(self, tmp_path):
+        """A real subprocess experiment: the child reads its DS config and
+        writes metrics.json, the manager parses the metric back."""
+        script = tmp_path / "exp.py"
+        script.write_text(
+            "import json, os\n"
+            "cfg = json.load(open(os.environ['DS_AUTOTUNING_CONFIG']))\n"
+            "mbs = cfg['train_micro_batch_size_per_gpu']\n"
+            "json.dump({'throughput': 10.0 * mbs},"
+            " open(os.environ['DS_AUTOTUNING_METRIC_PATH'], 'w'))\n")
+        rm = ResourceManager(str(tmp_path / "exps"),
+                             cmd=[sys.executable, str(script)])
+        v1 = rm.run_experiment("a", {"train_micro_batch_size_per_gpu": 2})
+        v2 = rm.run_experiment("b", {"train_micro_batch_size_per_gpu": 8})
+        assert (v1, v2) == (20.0, 80.0)
+        assert "2/2" in rm.status()
+        assert os.path.exists(tmp_path / "exps" / "a" / "ds_config.json")
+
+    def test_failed_experiment_returns_none(self, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("raise SystemExit(3)\n")
+        rm = ResourceManager(str(tmp_path / "exps"),
+                             cmd=[sys.executable, str(script)])
+        assert rm.run_experiment("x", {}) is None
+
+    def test_autotuner_with_resource_manager(self, tmp_path):
+        script = tmp_path / "exp.py"
+        script.write_text(
+            "import json, os\n"
+            "cfg = json.load(open(os.environ['DS_AUTOTUNING_CONFIG']))\n"
+            "mbs = cfg['train_micro_batch_size_per_gpu']\n"
+            "stage = cfg.get('zero_optimization', {}).get('stage', 0)\n"
+            "val = 100 - (mbs - 4) ** 2 - stage\n"
+            "json.dump({'throughput': val},"
+            " open(os.environ['DS_AUTOTUNING_METRIC_PATH'], 'w'))\n")
+        cfg = {"train_batch_size": 4,
+               "autotuning": {"enabled": True,
+                              "micro_batch_sizes": [2, 4, 8],
+                              "zero_stages": [0, 1]}}
+        rm = ResourceManager(str(tmp_path / "exps"),
+                             cmd=[sys.executable, str(script)])
+        at = Autotuner(cfg, resource_manager=rm, results_dir=str(tmp_path))
+        best = at.tune()
+        assert best["train_micro_batch_size_per_gpu"] == 4
+        assert best["zero_optimization"]["stage"] == 0

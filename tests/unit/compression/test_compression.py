@@ -1,0 +1,151 @@
+"""Compression suite tests (reference
+``tests/unit/compression/test_compression.py``): fake-quant math, pruning
+masks, config binding, scheduler offsets, redundancy_clean, and the
+engine-integrated compressed training path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (CompressionScheduler, apply_head_mask,
+                                       channel_mask, head_mask,
+                                       init_compression, quantize_activation,
+                                       quantize_weight, redundancy_clean,
+                                       row_mask, sparse_mask)
+
+
+class TestQuantOps:
+    def test_symmetric_levels(self):
+        w = jnp.asarray(np.linspace(-1, 1, 101), jnp.float32)
+        q = quantize_weight(w, bits=4)
+        # 4-bit symmetric: at most 15 distinct levels
+        assert len(np.unique(np.asarray(q).round(6))) <= 15
+        assert float(jnp.max(jnp.abs(q - w))) < 2.0 / 14 + 1e-6
+
+    def test_asymmetric_preserves_range(self):
+        w = jnp.asarray(np.random.default_rng(0).uniform(2.0, 3.0, 64), jnp.float32)
+        q = quantize_weight(w, bits=8, quant_type="asymmetric")
+        assert float(jnp.min(q)) >= 1.99 and float(jnp.max(q)) <= 3.01
+
+    def test_grouped_scales_differ(self):
+        w = jnp.concatenate([jnp.ones(32) * 0.01, jnp.ones(32) * 10.0])
+        q1 = quantize_weight(w, bits=4, groups=1)
+        q2 = quantize_weight(w, bits=4, groups=2)
+        # one global scale crushes the small half; per-group does not
+        assert float(jnp.abs(q2[:32] - 0.01).max()) < float(jnp.abs(q1[:32] - 0.01).max())
+
+    def test_stochastic_rounding_unbiased(self):
+        w = jnp.full((2048,), 0.3, jnp.float32)
+        qs = [quantize_weight(w, bits=2, rounding="stochastic",
+                              rng=jax.random.key(i)).mean() for i in range(16)]
+        assert abs(float(np.mean(qs)) - 0.3) < 0.05
+
+    def test_ste_gradient_passes_through(self):
+        w = jnp.asarray(np.random.default_rng(1).standard_normal(32), jnp.float32)
+        g = jax.grad(lambda w: jnp.sum(quantize_weight(w, bits=4) * 2.0))(w)
+        np.testing.assert_allclose(g, 2.0)
+
+    def test_activation_quant(self):
+        x = jnp.asarray(np.random.default_rng(2).standard_normal((8, 16)), jnp.float32)
+        q = quantize_activation(x, bits=8)
+        assert float(jnp.max(jnp.abs(q - x))) < float(jnp.max(jnp.abs(x))) / 100
+
+
+class TestPruningMasks:
+    W = jnp.asarray(np.random.default_rng(3).standard_normal((16, 32)), jnp.float32)
+
+    def test_sparse_ratio(self):
+        m = sparse_mask(self.W, ratio=0.75)
+        assert abs(float(m.mean()) - 0.25) < 0.01
+        # kept entries are the largest
+        assert float(jnp.abs(self.W[m]).min()) >= float(jnp.abs(self.W[~m]).max())
+
+    def test_row_mask(self):
+        m = row_mask(self.W, ratio=0.5)
+        assert m.shape == (32,) and int(m.sum()) == 16
+
+    def test_channel_mask(self):
+        m = channel_mask(self.W, ratio=0.25)
+        assert m.shape == (16,) and int(m.sum()) == 12
+
+    def test_head_mask(self):
+        w = jnp.asarray(np.random.default_rng(4).standard_normal((32, 32)), jnp.float32)
+        m = head_mask(w, ratio=0.5, num_heads=4)
+        assert m.shape == (4,) and int(m.sum()) == 2
+        masked = apply_head_mask(w, m, num_heads=4)
+        dead = np.repeat(~np.asarray(m), 8)
+        assert np.allclose(np.asarray(masked)[dead, :], 0.0)
+
+
+CFG = {"compression_training": {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                              "quantization_type": "symmetric"},
+        "different_groups": {
+            "wq1": {"params": {"target_bits": 8},
+                    "modules": [r"dense_w"]}}},
+    "row_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                              "method": "l1"},
+        "different_groups": {
+            "rp1": {"params": {"dense_ratio": 0.5},
+                    "modules": [r"dense_w"]}}},
+}}
+
+
+class TestSpecAndScheduler:
+    def test_binding_and_transform(self):
+        params = {"dense_w": jnp.asarray(
+            np.random.default_rng(5).standard_normal((8, 16)), jnp.float32),
+            "ln_g": jnp.ones((16,))}
+        spec = init_compression(params, CFG)
+        assert spec.plans["dense_w"].active() == ["weight_quant", "row"]
+        out = spec.transform(params, {"row_pruning": True})
+        cols = np.asarray(out["dense_w"]).any(axis=0)
+        assert cols.sum() == 8                      # half the rows zeroed
+        np.testing.assert_array_equal(out["ln_g"], params["ln_g"])
+
+    def test_scheduler_offsets(self):
+        s = CompressionScheduler(CFG["compression_training"])
+        f0 = s.check_all_modules(0)
+        assert f0 == {"weight_quantization": False, "row_pruning": True}
+        f2 = s.check_all_modules(2)
+        assert f2["weight_quantization"] is True
+
+    def test_redundancy_clean_shrinks(self):
+        params = {"dense_w": jnp.asarray(
+            np.random.default_rng(6).standard_normal((8, 16)), jnp.float32)}
+        spec = init_compression(params, CFG)
+        small = redundancy_clean(params, spec)
+        assert small["dense_w"].shape == (8, 8)
+
+
+class TestEngineCompression:
+    def test_compressed_training_runs_and_activates(self):
+        from deepspeed_tpu.models.simple import SimpleModel
+        model = SimpleModel(hidden_dim=32)
+        params = model.init_params(jax.random.key(0))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "compression_training": {
+                        "weight_quantization": {
+                            "shared_parameters": {"enabled": True,
+                                                  "schedule_offset": 2},
+                            "different_groups": {
+                                "g": {"params": {"target_bits": 8},
+                                      "modules": [r"kernel"]}}}}})
+        assert engine.compression_scheduler is not None
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 32)).astype(np.float32)
+        y = np.zeros((8,), np.int32)
+        for _ in range(4):
+            loss = engine.forward(x, y)
+            engine.backward(loss)
+            engine.step()
+            assert np.isfinite(float(loss))
+        assert engine._compression_enabled["weight_quantization"] is True

@@ -343,3 +343,35 @@ class TestEngineWiring:
         flat = jax.tree_util.tree_leaves(grads)
         assert all(np.all(np.isfinite(g)) for g in flat)
         assert any(np.any(g != 0) for g in flat)
+
+
+class TestSamplerUniformity:
+    def test_micro_batches_always_full_and_rank_aligned(self):
+        from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler
+        cfg = {"enabled": True, "seed": 1,
+               "data_sampling": {"enabled": True, "num_epochs": 1}}
+        # 10 samples, gbs=4: drop_last=False must still yield FULL batches
+        for rank in (0, 1):
+            s = DeepSpeedDataSampler(cfg, 10, 2, rank, 2, 1, drop_last=False)
+            micros = list(s)
+            assert all(len(m) == 2 for m in micros)
+            assert len(micros) == s.num_micro_batches
+        d = DeepSpeedDataSampler(cfg, 10, 2, 0, 2, 1, drop_last=True)
+        assert len(list(d)) == d.num_micro_batches == 2
+
+    def test_loader_len_with_sampler(self):
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        data = [(np.arange(4), np.int32(0))] * 32
+        loader = DeepSpeedDataLoader(data, batch_size=2, to_device=False,
+                                     data_sampler=[[0, 1], [2, 3]])
+        assert len(loader) == 2
+        from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler
+        cfg = {"enabled": True, "data_sampling": {"enabled": True, "num_epochs": 1}}
+        s = DeepSpeedDataSampler(cfg, 32, 2, 0, 1, 2)
+        loader2 = DeepSpeedDataLoader(data, batch_size=2, to_device=False,
+                                      data_sampler=s)
+        assert len(loader2) == s.num_micro_batches
+        import pytest as _pytest
+        with _pytest.raises(TypeError):
+            len(DeepSpeedDataLoader(data, batch_size=2, to_device=False,
+                                    data_sampler=iter([[0]])))
