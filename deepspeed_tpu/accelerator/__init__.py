@@ -1,0 +1,10 @@
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.real_accelerator import (get_accelerator,
+                                                        is_current_accelerator_supported,
+                                                        set_accelerator)
+from deepspeed_tpu.accelerator.tpu_accelerator import (CPU_Accelerator,
+                                                       TPU_Accelerator)
+
+__all__ = ["DeepSpeedAccelerator", "TPU_Accelerator", "CPU_Accelerator",
+           "get_accelerator", "set_accelerator",
+           "is_current_accelerator_supported"]

@@ -1,0 +1,179 @@
+"""Accelerator abstraction.
+
+Reference: ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` — the ~40-method device interface every
+device-touching component goes through) and ``real_accelerator.py:37``
+(``get_accelerator`` singleton with env override).
+
+TPU redesign: the surface keeps the reference's *capability groups*
+(device identity, synchronization, RNG, memory stats, dtype support,
+communication backend name, op-builder slot) but drops the CUDA-isms that
+have no TPU meaning — streams/events/graphs collapse onto XLA's async
+dispatch (``synchronize`` drains it), ``empty_cache`` is a no-op (XLA
+owns HBM), pinned memory maps to the ``pinned_host`` memory kind.  Those
+methods still exist so reference-shaped code runs; they are honest no-ops
+with docstrings saying why.
+"""
+
+import abc
+from typing import Dict, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # ---- device identity --------------------------------------------- #
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str: ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None): ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int: ...
+
+    @abc.abstractmethod
+    def current_device(self) -> int: ...
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    def set_device(self, device_index: int):
+        """No-op under SPMD: one process drives all local devices (the
+        reference's per-rank CUDA device selection has no analogue)."""
+
+    # ---- synchronization --------------------------------------------- #
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None): ...
+
+    # ---- RNG ----------------------------------------------------------- #
+    @abc.abstractmethod
+    def manual_seed(self, seed: int): ...
+
+    def manual_seed_all(self, seed: int):
+        self.manual_seed(seed)
+
+    @abc.abstractmethod
+    def initial_seed(self) -> int: ...
+
+    def random(self):
+        import numpy as np
+        return np.random
+
+    def get_rng_state(self, device_index=None):
+        return self.initial_seed()
+
+    def set_rng_state(self, new_state, device_index=None):
+        self.manual_seed(int(new_state))
+
+    # ---- streams / events (XLA: async dispatch, no user streams) ------- #
+    def stream(self, stream=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def current_stream(self, device_index=None):
+        return None
+
+    def default_stream(self, device_index=None):
+        return None
+
+    def Stream(self, *a, **k):
+        return None
+
+    def Event(self, *a, **k):
+        return None
+
+    # ---- memory -------------------------------------------------------- #
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict: ...
+
+    def memory_allocated(self, device_index=None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index=None) -> int:
+        return int(self.memory_stats(device_index).get("peak_bytes_in_use", 0))
+
+    def memory_reserved(self, device_index=None) -> int:
+        return self.memory_allocated(device_index)
+
+    def max_memory_reserved(self, device_index=None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    def total_memory(self, device_index=None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index=None) -> int:
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    def empty_cache(self):
+        """XLA owns the HBM arena; there is no allocator cache to drop."""
+
+    def reset_peak_memory_stats(self, device_index=None):
+        """Peak counters live in the runtime; not resettable from here."""
+
+    memory_cached = memory_reserved
+    max_memory_cached = max_memory_reserved
+    reset_max_memory_allocated = reset_peak_memory_stats
+    reset_max_memory_cached = reset_peak_memory_stats
+
+    # ---- dtype support ------------------------------------------------- #
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool: ...
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        out = [jnp.float32]
+        if self.is_bf16_supported():
+            out.append(jnp.bfloat16)
+        if self.is_fp16_supported():
+            out.append(jnp.float16)
+        return out
+
+    # ---- graphs (→ jit) ------------------------------------------------ #
+    def is_triton_supported(self) -> bool:
+        return False
+
+    def create_graph(self):
+        return None
+
+    def capture_to_graph(self, graph, **kwargs):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def replay_graph(self, graph):
+        """jit replay is implicit — compiled programs are cached."""
+
+    # ---- communication / ops ------------------------------------------ #
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str: ...
+
+    def is_initialized(self) -> bool:
+        return True
+
+    @abc.abstractmethod
+    def is_available(self) -> bool: ...
+
+    def op_builder_dir(self) -> str:
+        """Op 'building' is Pallas/XLA compilation; there is no extension
+        dir, but the slot reports where kernels live."""
+        return "deepspeed_tpu.ops"
+
+    def on_accelerator(self, array) -> bool:
+        import jax
+        return isinstance(array, jax.Array)
+
+    # ---- host/pinned memory ------------------------------------------- #
+    @abc.abstractmethod
+    def pin_memory(self, array): ...
+
+    def is_pinned(self, array) -> bool:
+        try:
+            return array.sharding.memory_kind == "pinned_host"
+        except AttributeError:
+            return False

@@ -1,0 +1,107 @@
+"""TPU implementation of the accelerator interface.
+
+Reference: ``accelerator/cuda_accelerator.py:19`` (``CUDA_Accelerator``).
+Everything is backed by jax device APIs; ``synchronize`` drains the async
+dispatch queue (the only fence TPU needs), memory stats come from
+``device.memory_stats()``, pinned memory is the ``pinned_host`` memory
+kind.
+"""
+
+from typing import Dict, Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+        self._seed = 0
+
+    # ---- device identity --------------------------------------------- #
+    def _devices(self):
+        import jax
+        return jax.local_devices()
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        devs = self._devices()
+        return devs[device_index or 0]
+
+    def device_count(self) -> int:
+        import jax
+        return jax.local_device_count()
+
+    def current_device(self) -> int:
+        return 0
+
+    # ---- synchronization --------------------------------------------- #
+    def synchronize(self, device_index: Optional[int] = None):
+        import jax
+        jax.block_until_ready(jax.device_put(0))
+
+    # ---- RNG ----------------------------------------------------------- #
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    # ---- memory -------------------------------------------------------- #
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict:
+        try:
+            return dict(self.device(device_index).memory_stats() or {})
+        except Exception:
+            return {}
+
+    # ---- dtype support ------------------------------------------------- #
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True   # storable; bf16 is the native fast path
+
+    # ---- communication / availability ---------------------------------- #
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def is_available(self) -> bool:
+        try:
+            return any(d.platform == "tpu" for d in self._devices())
+        except Exception:
+            return False
+
+    # ---- pinned host memory ------------------------------------------- #
+    def pin_memory(self, array):
+        import jax
+        sh = getattr(array, "sharding", None)
+        if sh is not None:
+            return jax.device_put(array, sh.with_memory_kind("pinned_host"))
+        return array
+
+
+class CPU_Accelerator(TPU_Accelerator):
+    """CPU fallback (virtual-mesh CI, the reference's CPU_Accelerator)."""
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "gloo"
+
+    def is_available(self) -> bool:
+        return True
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return False
+
+    def pin_memory(self, array):
+        return array   # host memory already

@@ -78,7 +78,7 @@ class ResourceManager:
             with open(metric_path) as f:
                 data = json.load(f)
             return float(data.get(self.metric)) if self.metric in data else None
-        except (ValueError, OSError):
+        except (ValueError, TypeError, OSError):
             return None
 
     def status(self) -> str:

@@ -19,12 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.compression import basic_ops as ops
-from deepspeed_tpu.compression.scheduler import CompressionScheduler
+from deepspeed_tpu.compression.scheduler import TECHNIQUES, CompressionScheduler
 from deepspeed_tpu.utils.logging import log_dist
-
-TECHNIQUES = ("weight_quantization", "activation_quantization",
-              "sparse_pruning", "row_pruning", "head_pruning",
-              "channel_pruning")
 
 
 def _path_str(path) -> str:
@@ -125,6 +121,16 @@ def init_compression(params, ds_config: Dict,
     ``num_heads`` feeds head pruning (the reference reads it from the
     group's ``related_modules``/mpu; here the caller states it)."""
     cfg = ds_config.get("compression_training", ds_config) or {}
+    if (cfg.get("activation_quantization", {})
+            .get("shared_parameters", {}).get("enabled", False)):
+        # activation quant lives inside the model's forward, which a pure
+        # parameter transform cannot reach — refuse loudly rather than
+        # silently skipping it; models call quantize_activation directly
+        raise NotImplementedError(
+            "activation_quantization is not wired through init_compression: "
+            "call deepspeed_tpu.compression.quantize_activation inside the "
+            "model's forward (the engine-side transform only touches "
+            "parameters)")
     plans: Dict[str, LeafPlan] = {}
 
     def plan(name) -> LeafPlan:

@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.aio.aio_handle import AsyncIOBuilder, AIOHandle
+
+__all__ = ["AIOHandle", "AsyncIOBuilder"]

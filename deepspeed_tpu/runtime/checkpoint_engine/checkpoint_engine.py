@@ -130,7 +130,11 @@ class LocalCheckpointEngine(CheckpointEngine):
         if target is not None:
             treedef = jax.tree_util.tree_structure(target)
         else:
-            treedef = self._treedefs[path]
+            treedef = getattr(self, "_treedefs", {}).get(path)
+            if treedef is None:
+                raise ValueError(
+                    "LocalCheckpointEngine.load needs target= in a fresh "
+                    "process (the npz stores leaves, not the tree structure)")
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

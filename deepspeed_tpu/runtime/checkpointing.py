@@ -46,9 +46,11 @@ def _ckpt_engine(engine):
 
 
 def _engine_tree(engine) -> Dict[str, Any]:
+    opt = (engine._opt_state_view() if hasattr(engine, "_opt_state_view")
+           else engine.state.opt_state)
     return {
         "params": engine.state.params,
-        "opt_state": engine.state.opt_state,
+        "opt_state": opt,
         "scaler": engine.state.scaler._asdict(),
         "skipped": engine.state.skipped,
     }
@@ -81,20 +83,38 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
             json.dump(meta, f)
-    # commit is the durability barrier (async engines wait here); only a
-    # durable checkpoint may become 'latest' — a crash mid-stream must not
-    # leave the pointer aimed at torn bytes
-    ce.commit(tag)
-    if save_latest and jax.process_index() == 0:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(tag)
-    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+
+    def _finalize():
+        # commit is the durability barrier; only a durable checkpoint may
+        # become 'latest' — a crash mid-stream must not leave the pointer
+        # aimed at torn bytes
+        ce.commit(tag)
+        if save_latest and jax.process_index() == 0:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(tag)
+        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+
+    if getattr(ce, "async_save", False):
+        # async engine: training resumes now; durability + pointer move
+        # complete in the background (joined by the next load/save/wait)
+        import threading
+        prev = getattr(engine, "_ckpt_finalizer", None)
+        if prev is not None and prev.is_alive():
+            prev.join()
+        t = threading.Thread(target=_finalize, daemon=True)
+        t.start()
+        engine._ckpt_finalizer = t
+    else:
+        _finalize()
     return True
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
                     load_module_only: bool = False):
+    fin = getattr(engine, "_ckpt_finalizer", None)
+    if fin is not None and fin.is_alive():
+        fin.join()
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.isfile(latest):
@@ -111,9 +131,11 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     # Restore with the *current* engine shardings — a different mesh/stage
     # than at save time reshards on read (elastic checkpointing,
     # reference ``engine.py:735`` / ``deepspeed/checkpoint``).
+    opt_view = (engine._opt_state_view() if hasattr(engine, "_opt_state_view")
+                else engine.state.opt_state)
     target = {
         "params": _abstract(engine.state.params, engine.param_shardings),
-        "opt_state": _abstract(engine.state.opt_state, engine.opt_shardings),
+        "opt_state": _abstract(opt_view, engine.opt_shardings),
         "scaler": jax.tree.map(_abstract_leaf_replicated(engine), engine.state.scaler._asdict()),
         "skipped": _abstract_leaf_replicated(engine)(engine.state.skipped),
     }
@@ -121,7 +143,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     engine.state.params = restored["params"]
     if load_optimizer_states and not load_module_only:
-        engine.state.opt_state = restored["opt_state"]
+        if getattr(engine, "optimizer_swapper", None) is not None:
+            # ZeRO-Infinity: restored state goes straight back to NVMe
+            engine.optimizer_swapper.swap_out(restored["opt_state"])
+            engine.state.opt_state = None
+        else:
+            engine.state.opt_state = restored["opt_state"]
     from deepspeed_tpu.runtime.fp16.loss_scaler import LossScalerState
     engine.state.scaler = LossScalerState(**restored["scaler"])
     engine.state.skipped = restored["skipped"]

@@ -43,10 +43,19 @@ class Eigenvalue:
         return jax.tree.map(lambda x: jnp.nan_to_num(x / norm, posinf=0.0,
                                                      neginf=0.0), v)
 
+    def make_hvp(self, loss_fn: Callable) -> Callable:
+        """A jitted Hessian-vector product for ``loss_fn``.  Build ONCE
+        and reuse across calls — re-jitting per call would recompile the
+        whole forward+backward+jvp every invocation."""
+        grad_fn = jax.grad(loss_fn)
+        return jax.jit(lambda p, vec: jax.jvp(grad_fn, (p,), (vec,))[1])
+
     def compute_eigenvalue(self, loss_fn: Callable, block_params,
-                           rng: Optional[jax.Array] = None) -> float:
+                           rng: Optional[jax.Array] = None,
+                           hvp_fn: Optional[Callable] = None) -> float:
         """Max |eigenvalue| of the Hessian of ``loss_fn`` at
-        ``block_params`` by power iteration on HVPs."""
+        ``block_params`` by power iteration on HVPs.  Pass a cached
+        ``hvp_fn`` (from :meth:`make_hvp`) on hot paths."""
         rng = rng if rng is not None else jax.random.key(0)
         keys = jax.random.split(rng, len(jax.tree.leaves(block_params)))
         v = jax.tree.unflatten(
@@ -54,11 +63,7 @@ class Eigenvalue:
             [jax.random.normal(k, p.shape, jnp.float32)
              for k, p in zip(keys, jax.tree.leaves(block_params))])
         v = self._normalize(v)
-        grad_fn = jax.grad(loss_fn)
-
-        @jax.jit
-        def hvp(p, vec):
-            return jax.jvp(grad_fn, (p,), (vec,))[1]
+        hvp = hvp_fn if hvp_fn is not None else self.make_hvp(loss_fn)
 
         eig = 0.0
         for i in range(self.max_iter):

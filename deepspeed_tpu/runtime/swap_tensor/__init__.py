@@ -1,0 +1,10 @@
+from deepspeed_tpu.runtime.swap_tensor.aio_config import get_aio_config
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import (
+    AsyncPartitionedParameterSwapper)
+from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (
+    PartitionedOptimizerSwapper, PipelinedOptimizerSwapper)
+
+__all__ = ["get_aio_config", "AsyncTensorSwapper",
+           "AsyncPartitionedParameterSwapper", "PartitionedOptimizerSwapper",
+           "PipelinedOptimizerSwapper"]

@@ -1,0 +1,20 @@
+"""AIO / swap config (reference ``runtime/swap_tensor/aio_config.py`` and
+the ``aio`` JSON block: block_size, queue_depth, thread_count,
+single_submit, overlap_events)."""
+
+from typing import Dict
+
+AIO_DEFAULTS = {
+    "block_size": 1 << 20,
+    "queue_depth": 32,
+    "thread_count": 4,
+    "single_submit": False,
+    "overlap_events": True,
+    "use_o_direct": False,
+}
+
+
+def get_aio_config(param_dict: Dict) -> Dict:
+    cfg = dict(AIO_DEFAULTS)
+    cfg.update(param_dict.get("aio", {}) or {})
+    return cfg

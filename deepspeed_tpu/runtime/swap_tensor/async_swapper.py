@@ -1,0 +1,69 @@
+"""Asynchronous tensor swapper.
+
+Reference: ``runtime/swap_tensor/async_swapper.py:18``
+(``AsyncTensorSwapper``): stream tensors to swap files through the native
+aio engine without blocking the trainer; ``swap_out`` enqueues,
+``synchronize`` joins.  Buffers are host numpy copies (for ``jax.Array``
+inputs the device→host transfer happens on enqueue; the disk write then
+overlaps the next training work).
+"""
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AIOHandle
+from deepspeed_tpu.runtime.swap_tensor.aio_config import get_aio_config
+
+
+def swap_path(folder: str, key: str) -> str:
+    return os.path.join(folder, f"{key}.swp")
+
+
+class AsyncTensorSwapper:
+
+    def __init__(self, aio_config: Optional[Dict] = None,
+                 swap_folder: str = "/tmp/dst_swap", handle: Optional[AIOHandle] = None):
+        cfg = get_aio_config({"aio": aio_config or {}})
+        self.swap_folder = swap_folder
+        os.makedirs(swap_folder, exist_ok=True)
+        self.handle = handle or AIOHandle(
+            block_size=cfg["block_size"], queue_depth=cfg["queue_depth"],
+            single_submit=cfg["single_submit"],
+            overlap_events=cfg["overlap_events"],
+            num_threads=cfg["thread_count"],
+            use_o_direct=cfg["use_o_direct"])
+        # in-flight buffers must stay alive until the write completes
+        self._inflight: Dict[int, np.ndarray] = {}
+        self.swap_count = 0
+        self.bytes_swapped = 0
+
+    def swap_out(self, key: str, array) -> int:
+        """Enqueue an async write of ``array`` under ``key``; returns the
+        request id."""
+        host = np.ascontiguousarray(np.asarray(array))
+        rid = self.handle.async_pwrite(host, swap_path(self.swap_folder, key))
+        self._inflight[rid] = host        # pin until joined
+        self.swap_count += 1
+        self.bytes_swapped += host.nbytes
+        return rid
+
+    def swap_in(self, key: str, shape, dtype) -> np.ndarray:
+        """Synchronous read of a previously swapped tensor."""
+        out = np.empty(shape, dtype)
+        self.handle.pread(out, swap_path(self.swap_folder, key))
+        return out
+
+    def async_swap_in(self, key: str, shape, dtype):
+        out = np.empty(shape, dtype)
+        rid = self.handle.async_pread(out, swap_path(self.swap_folder, key))
+        self._inflight[rid] = out
+        return rid, out
+
+    def synchronize(self, request_id: Optional[int] = None):
+        self.handle.wait(request_id)
+        if request_id is not None:
+            self._inflight.pop(request_id, None)
+        else:
+            self._inflight.clear()

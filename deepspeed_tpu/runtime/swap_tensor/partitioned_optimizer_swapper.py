@@ -1,0 +1,60 @@
+"""NVMe optimizer-state swapper (ZeRO-Infinity).
+
+Reference: ``runtime/swap_tensor/partitioned_optimizer_swapper.py:28`` /
+``optimizer_utils.py:112`` (``OptimizerSwapper``): optimizer state lives
+on NVMe between steps; each step swaps the needed partitions in, updates,
+and swaps them back out, overlapping the write-back with the next
+forward/backward.
+
+Engine contract here: ``swap_out`` after ``step()`` (async — returns
+immediately, device buffers released by dropping references),
+``swap_in(shardings)`` right before the next update.  The pipelined
+variant (reference ``pipelined_optimizer_swapper.py:51``) is the same
+object driven with ``prefetch()`` at forward time.
+"""
+
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import (
+    AsyncPartitionedParameterSwapper)
+
+
+class PartitionedOptimizerSwapper:
+
+    PREFIX = "opt"
+
+    def __init__(self, swap_folder: str, aio_config: Optional[Dict] = None):
+        self._swapper = AsyncPartitionedParameterSwapper(swap_folder, aio_config)
+        self._template = None       # shapes/dtypes pytree (host copy of state)
+
+    @property
+    def is_swapped(self) -> bool:
+        return self._template is not None
+
+    def swap_out(self, opt_state) -> None:
+        """Persist the whole optimizer state to swap files; keeps only an
+        abstract template in memory."""
+        import jax
+        self._template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), opt_state)
+        self._swapper.swap_out_tree(opt_state, prefix=self.PREFIX)
+
+    def prefetch(self) -> None:
+        """Begin async reads (call at forward time to overlap with compute)."""
+        if self._template is not None:
+            self._swapper.prefetch_tree(self._template, prefix=self.PREFIX)
+
+    def swap_in(self, shardings=None):
+        """Materialize the optimizer state (joins prefetches)."""
+        assert self._template is not None, "nothing swapped out"
+        out = self._swapper.swap_in_tree(self._template, shardings,
+                                         prefix=self.PREFIX)
+        return out
+
+    def swapped_bytes(self) -> int:
+        return self._swapper.swapped_bytes()
+
+
+# reference-name alias: the separate class there only changes the driving
+# schedule, which here is the caller's prefetch() timing
+PipelinedOptimizerSwapper = PartitionedOptimizerSwapper

@@ -1,0 +1,83 @@
+"""NVMe parameter swapper.
+
+Reference: ``runtime/swap_tensor/partitioned_param_swapper.py:36``
+(``AsyncPartitionedParameterSwapper``): maps partitioned parameters to
+swap files, gathers/releases them around use, keeps a bounded pool of
+staging buffers.  Functional recast: a pytree's leaves swap out to one
+file each; ``swap_in_tree`` brings them back (optionally async with
+prefetch), re-placing onto the caller's shardings.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import (AsyncTensorSwapper,
+                                                             swap_path)
+
+
+def _leaf_key(path) -> str:
+    parts = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+    return "__".join(parts) or "leaf"
+
+
+class AsyncPartitionedParameterSwapper:
+
+    def __init__(self, swap_folder: str, aio_config: Optional[Dict] = None):
+        self.swapper = AsyncTensorSwapper(aio_config, swap_folder)
+        self.swap_folder = swap_folder
+        self._meta: Dict[str, Any] = {}      # key -> (shape, dtype)
+        self._prefetch: Dict[str, Any] = {}  # key -> (request id, buffer)
+
+    # ---- whole-pytree surface ----------------------------------------- #
+    def swap_out_tree(self, tree, prefix: str = "p") -> None:
+        """Write every array leaf to its swap file (async), record metadata,
+        and join before returning (the tree's device memory may then be
+        released by the caller)."""
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+            key = f"{prefix}__{_leaf_key(path)}"
+            host = np.asarray(leaf)
+            self._meta[key] = (host.shape, host.dtype)
+            self.swapper.swap_out(key, host)
+        self.swapper.synchronize()
+
+    def prefetch_tree(self, tree_def_like, prefix: str = "p") -> None:
+        """Start async reads for every leaf (reference prefetch path)."""
+        for path, _ in jax.tree_util.tree_leaves_with_path(tree_def_like):
+            key = f"{prefix}__{_leaf_key(path)}"
+            shape, dtype = self._meta[key]
+            self._prefetch[key] = self.swapper.async_swap_in(key, shape, dtype)
+
+    def swap_in_tree(self, tree_def_like, shardings=None, prefix: str = "p"):
+        """Read every leaf back (joining prefetches when present) and
+        rebuild the pytree; with ``shardings``, leaves are device_put."""
+        leaves = []
+        paths = jax.tree_util.tree_leaves_with_path(tree_def_like)
+        shard_leaves = (jax.tree_util.tree_leaves(shardings)
+                        if shardings is not None else [None] * len(paths))
+        for (path, _), sh in zip(paths, shard_leaves):
+            key = f"{prefix}__{_leaf_key(path)}"
+            if key in self._prefetch:
+                rid, buf = self._prefetch.pop(key)
+                self.swapper.synchronize(rid)
+            else:
+                shape, dtype = self._meta[key]
+                buf = self.swapper.swap_in(key, shape, dtype)
+            leaves.append(jax.device_put(buf, sh) if sh is not None else buf)
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(tree_def_like), leaves)
+
+    def swapped_bytes(self) -> int:
+        return self.swapper.bytes_swapped
+
+    def remove(self, prefix: str = "p"):
+        for key in list(self._meta):
+            if key.startswith(prefix + "__"):
+                try:
+                    os.remove(swap_path(self.swap_folder, key))
+                except OSError:
+                    pass
+                del self._meta[key]

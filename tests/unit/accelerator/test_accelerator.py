@@ -1,0 +1,68 @@
+"""Accelerator abstraction conformance (reference ``tests/accelerator/``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator import (CPU_Accelerator, DeepSpeedAccelerator,
+                                       TPU_Accelerator, get_accelerator,
+                                       set_accelerator)
+
+
+class TestConformance:
+    def test_singleton_and_detection(self):
+        a = get_accelerator()
+        assert isinstance(a, DeepSpeedAccelerator)
+        assert a is get_accelerator()
+        assert a._name in ("tpu", "cpu")
+
+    def test_set_accelerator_overrides(self):
+        prev = get_accelerator()
+        try:
+            set_accelerator(CPU_Accelerator())
+            assert get_accelerator()._name == "cpu"
+            assert get_accelerator().communication_backend_name() == "gloo"
+        finally:
+            set_accelerator(prev)
+
+    def test_device_surface(self):
+        a = get_accelerator()
+        assert a.device_count() >= 1
+        assert a.device_name(0).endswith(":0")
+        assert a.device(0) in jax.local_devices()
+        a.synchronize()                      # drains async dispatch
+
+    def test_memory_stats(self):
+        a = get_accelerator()
+        _ = jax.device_put(jnp.ones((128, 128)))
+        stats = a.memory_stats()
+        assert isinstance(stats, dict)
+        assert a.memory_allocated() >= 0
+
+    def test_rng_and_seeds(self):
+        a = get_accelerator()
+        a.manual_seed(123)
+        assert a.initial_seed() == 123
+
+    def test_dtype_support(self):
+        a = get_accelerator()
+        assert a.is_bf16_supported()
+        assert jnp.bfloat16 in a.supported_dtypes()
+
+    def test_noop_cuda_isms_exist(self):
+        a = get_accelerator()
+        with a.stream():
+            pass
+        a.empty_cache()
+        a.replay_graph(a.create_graph())
+        assert a.Stream() is None and a.Event() is None
+
+    def test_on_accelerator(self):
+        a = get_accelerator()
+        assert a.on_accelerator(jnp.ones(3))
+        assert not a.on_accelerator(np.ones(3))
+
+    def test_op_builder_dir(self):
+        assert get_accelerator().op_builder_dir() == "deepspeed_tpu.ops"
