@@ -8,6 +8,8 @@ built on JAX/XLA/pjit/Pallas instead of torch/CUDA.
 
 from typing import Optional
 
+import os
+
 from deepspeed_tpu.version import __version__
 from deepspeed_tpu import comm
 from deepspeed_tpu.comm.comm import init_distributed
@@ -42,6 +44,28 @@ def initialize(args=None,
     """
     log_dist(f"deepspeed_tpu info: version={__version__}", ranks=[0])
     config = config if config is not None else config_params
+    # autotuning experiment mode: the launcher points DS_AUTOTUNING_CONFIG
+    # at this run's mutated config (reference: experiments run with
+    # exp-specific ds_config json)
+    from deepspeed_tpu.autotuning.scheduler import CONFIG_PATH_ENV
+    _at_cfg = os.environ.get(CONFIG_PATH_ENV)
+    if _at_cfg and os.path.isfile(_at_cfg):
+        import json as _json
+        with open(_at_cfg) as _f:
+            config = _json.load(_f)
+        log_dist(f"autotuning: using experiment config {_at_cfg}", ranks=[0])
+    # elastic agent restart: the re-solved batch config arrives in env
+    # (elasticity/elastic_agent.py writes it before each worker start)
+    if os.environ.get("DS_ELASTIC_TRAIN_BATCH") and isinstance(config, dict):
+        config = dict(config)
+        config["train_batch_size"] = int(os.environ["DS_ELASTIC_TRAIN_BATCH"])
+        config["train_micro_batch_size_per_gpu"] = int(
+            os.environ.get("DS_ELASTIC_MICRO_BATCH",
+                           config.get("train_micro_batch_size_per_gpu", 1)))
+        config.pop("gradient_accumulation_steps", None)  # re-derived
+        log_dist(f"elastic restart: train_batch="
+                 f"{config['train_batch_size']}, micro="
+                 f"{config['train_micro_batch_size_per_gpu']}", ranks=[0])
 
     from deepspeed_tpu.runtime.pipe.module import PipelineModule
     if isinstance(model, PipelineModule):

@@ -1,0 +1,125 @@
+"""Elastic training agent: worker supervision + membership-change restart.
+
+Reference: ``deepspeed/elasticity/elastic_agent.py:28`` (``DSElasticAgent``
+subclasses torch-elastic's ``LocalElasticAgent``: monitors workers,
+restarts the group on failure/membership change, propagates env).
+
+TPU redesign: there is no torch-elastic rendezvous; membership is the
+accelerator pod itself.  The agent supervises the per-host worker
+processes spawned by the ``dst`` launcher, and on a worker failure or a
+resource-set change it kills the group and relaunches with a batch
+configuration re-solved by the elasticity solver
+(``elasticity.compute_elastic_config``) for the new world size —
+restart-with-reshard replaces in-band recovery, with resumable
+checkpoints carrying the state (SURVEY §5.3's TPU mapping).
+"""
+
+import os
+import signal
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class WorkerSpec:
+    """What to run on each (re)start: argv template + env."""
+
+    def __init__(self, cmd: List[str], env: Optional[Dict[str, str]] = None):
+        self.cmd = list(cmd)
+        self.env = dict(env or {})
+
+
+class DSElasticAgent:
+
+    def __init__(self, spec: WorkerSpec, ds_config: Optional[Dict] = None,
+                 max_restarts: int = 3, monitor_interval: float = 1.0,
+                 world_size_fn: Optional[Callable[[], int]] = None):
+        """``world_size_fn`` reports the currently-available world size
+        (pod metadata / scheduler probe); a change triggers a restart with
+        a re-solved elastic batch config."""
+        self.spec = spec
+        self.ds_config = ds_config or {}
+        self.max_restarts = max_restarts
+        self.monitor_interval = monitor_interval
+        self.world_size_fn = world_size_fn or (lambda: 1)
+        self.restart_count = 0
+        self._proc: Optional[subprocess.Popen] = None
+        self._world = None
+
+    # ------------------------------------------------------------------ #
+    def _elastic_env(self, world: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.spec.env)
+        env["DS_ELASTIC_WORLD_SIZE"] = str(world)
+        if self.ds_config.get("elasticity", {}).get("enabled", False):
+            batch, _valid, micro = compute_elastic_config(
+                self.ds_config, "0.0", world_size=world,
+                return_microbatch=True)
+            env["DS_ELASTIC_TRAIN_BATCH"] = str(batch)
+            env["DS_ELASTIC_MICRO_BATCH"] = str(micro)
+            log_dist(f"elastic agent: world={world} -> train_batch={batch}, "
+                     f"micro={micro}", ranks=[0])
+        return env
+
+    def _start(self, world: int):
+        self._world = world
+        self._proc = subprocess.Popen(self.spec.cmd,
+                                      env=self._elastic_env(world),
+                                      start_new_session=True)
+        log_dist(f"elastic agent: started workers (pid {self._proc.pid}, "
+                 f"world {world})", ranks=[0])
+
+    def _stop(self):
+        if self._proc is None or self._proc.poll() is not None:
+            return
+        try:   # kill the whole process group (launcher children included)
+            os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            self._proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self._proc.wait()
+
+    # ------------------------------------------------------------------ #
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Supervise until the workers exit cleanly, restarts are
+        exhausted, or ``max_steps`` monitor ticks pass (testing hook).
+        Returns the final exit code."""
+        self._start(self.world_size_fn())
+        ticks = 0
+        while True:
+            time.sleep(self.monitor_interval)
+            ticks += 1
+            rc = self._proc.poll()
+            if rc is not None:
+                if rc == 0:
+                    log_dist("elastic agent: workers finished", ranks=[0])
+                    return 0
+                if self.restart_count >= self.max_restarts:
+                    logger.error(f"elastic agent: giving up after "
+                                 f"{self.restart_count} restarts (rc={rc})")
+                    return rc
+                self.restart_count += 1
+                log_dist(f"elastic agent: worker failure rc={rc} — restart "
+                         f"{self.restart_count}/{self.max_restarts}", ranks=[0])
+                self._start(self.world_size_fn())
+                continue
+            world = self.world_size_fn()
+            if world != self._world:
+                # membership change (preemption / scale-up): restart with a
+                # re-solved batch config; checkpoints reshard on resume
+                log_dist(f"elastic agent: membership {self._world} -> {world}; "
+                         f"restarting", ranks=[0])
+                self._stop()
+                self._start(world)
+            if max_steps is not None and ticks >= max_steps:
+                self._stop()
+                return 0

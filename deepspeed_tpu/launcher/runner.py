@@ -28,7 +28,8 @@ from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner,
 from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
-EXPORT_ENVS = ["PYTHON", "PATH", "LD_LIBRARY", "JAX", "XLA", "TPU", "LIBTPU", "DST"]
+EXPORT_ENVS = ["PYTHON", "PATH", "LD_LIBRARY", "JAX", "XLA", "TPU", "LIBTPU",
+               "DST", "DS_"]  # DS_: autotuning/elastic experiment contract
 DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
 
 
@@ -61,6 +62,11 @@ def parse_args(args=None):
     parser.add_argument("--autotuning", type=str, default="",
                         choices=["", "tune", "run"],
                         help="Run the autotuner to discover config values")
+    parser.add_argument("--enable_elastic_training", action="store_true",
+                        help="Supervise workers with the elastic agent: "
+                             "restart on failure / membership change")
+    parser.add_argument("--max_elastic_restarts", type=int, default=3,
+                        help="Elastic agent restart budget")
     parser.add_argument("user_script", type=str, help="User training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER,
                         help="User script arguments")
@@ -185,18 +191,43 @@ def build_launch_cmd(args, resources: "OrderedDict[str, int]") -> List[str]:
     return cmd
 
 
+def _find_user_config(user_args):
+    """Pull the --deepspeed_config path out of the user script args."""
+    for i, a in enumerate(user_args):
+        if a == "--deepspeed_config" and i + 1 < len(user_args):
+            return user_args[i + 1]
+        if a.startswith("--deepspeed_config="):
+            return a.split("=", 1)[1]
+    return None
+
+
 def main(args=None):
     args = parse_args(args)
 
     if args.autotuning:
-        from deepspeed_tpu.autotuning.autotuner import Autotuner
-        tuner = Autotuner(args)
+        # reference runner.py:439: the launcher hands off to the autotuner,
+        # which launches experiment runs of the user script (each reads its
+        # mutated config via DS_AUTOTUNING_CONFIG — deepspeed_tpu.initialize
+        # honors that env var) and writes the optimal config
+        from deepspeed_tpu.autotuning import Autotuner, ResourceManager
+        cfg_path = _find_user_config(args.user_args)
+        assert cfg_path, ("--autotuning needs --deepspeed_config <json> in "
+                          "the user script arguments")
+        with open(cfg_path) as f:
+            user_config = json.load(f)
+        cmd = [sys.executable, args.user_script] + list(args.user_args)
+        rm = ResourceManager("autotuning_exps", cmd=cmd,
+                             metric=user_config.get("autotuning", {})
+                             .get("metric", "throughput"))
+        tuner = Autotuner(user_config, resource_manager=rm)
         best = tuner.tune()
-        if args.autotuning == "tune":
+        if args.autotuning == "tune" or best is None:
             logger.info(f"autotuning done; best config: {best}")
             return 0
-        # 'run': fall through and launch with the tuned config env
-        os.environ["DST_AUTOTUNED_CONFIG"] = json.dumps(best)
+        # 'run': launch the real job with the tuned config
+        from deepspeed_tpu.autotuning import CONFIG_PATH_ENV
+        os.environ[CONFIG_PATH_ENV] = os.path.join(
+            tuner.results_dir, "ds_config_optimal.json")
 
     resources = fetch_hostfile(args.hostfile)
     if not resources:
@@ -212,6 +243,14 @@ def main(args=None):
     if not multi_node:
         cmd = build_launch_cmd(args, resources)
         logger.info(f"dst single-node: {' '.join(map(shlex.quote, cmd))}")
+        if args.enable_elastic_training:
+            from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                                WorkerSpec)
+            cfg_path = _find_user_config(args.user_args)
+            ds_cfg = json.load(open(cfg_path)) if cfg_path else {}
+            agent = DSElasticAgent(WorkerSpec(cmd), ds_config=ds_cfg,
+                                   max_restarts=args.max_elastic_restarts)
+            return agent.run()
         result = subprocess.run(cmd)
         return result.returncode
 
@@ -222,6 +261,15 @@ def main(args=None):
     cmd = runner.get_cmd(exports, resources)
     logger.info(f"dst multi-node ({args.launcher}): "
                 f"{' '.join(map(shlex.quote, cmd))}")
+    if args.enable_elastic_training:
+        from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                            WorkerSpec)
+        cfg_path = _find_user_config(args.user_args)
+        ds_cfg = json.load(open(cfg_path)) if cfg_path else {}
+        agent = DSElasticAgent(WorkerSpec(cmd), ds_config=ds_cfg,
+                               max_restarts=args.max_elastic_restarts,
+                               world_size_fn=lambda: sum(resources.values()))
+        return agent.run()
     result = subprocess.run(cmd)
     return result.returncode
 

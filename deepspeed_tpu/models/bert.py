@@ -1,0 +1,271 @@
+"""BERT encoder family — the reference's headline benchmark model.
+
+The reference's fused transformer training kernel
+(``csrc/transformer/ds_transformer_cuda.cpp`` + the
+``DeepSpeedTransformerLayer`` wrapper, ``ops/transformer/transformer.py:296``)
+is a BERT-style encoder layer, and its 64-TFLOPS/V100 record
+(BASELINE.md) is BERT-large pretraining.  This is the TPU-native encoder:
+
+* classic post-LN blocks (``pre_ln=True`` gives the preln variant the
+  reference ships as ``modelingpreln.py``);
+* bidirectional Pallas flash attention (``causal=False``);
+* ``lax.scan`` over layers, Megatron TP partition specs, ZeRO-composable
+  — same machinery as ``models/gpt.py``;
+* masked-LM loss with padded-vocab masking (pretraining objective).
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.models.gpt import (_activation, _dense_init, _dropout,
+                                      layer_norm)
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+Array = jax.Array
+_constrain = mesh_lib.constrain
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None
+    hidden_dropout_prob: float = 0.0
+    pre_ln: bool = False          # reference's modelingpreln variant
+    scan_layers: bool = True
+    remat: bool = False
+    attn_impl: str = "auto"
+    dtype: Any = jnp.bfloat16
+    ln_eps: float = 1e-12
+    vocab_multiple: int = 128
+
+    def __post_init__(self):
+        self.padded_vocab = int(math.ceil(
+            self.vocab_size / self.vocab_multiple) * self.vocab_multiple)
+        assert self.hidden_size % self.num_attention_heads == 0
+        self.head_dim = self.hidden_size // self.num_attention_heads
+        self.ffn = self.intermediate_size or 4 * self.hidden_size
+
+
+BERT_PRESETS = {
+    "tiny":       dict(vocab_size=512, max_position_embeddings=128,
+                       hidden_size=64, num_hidden_layers=2, num_attention_heads=4),
+    "bert-base":  dict(hidden_size=768, num_hidden_layers=12, num_attention_heads=12),
+    "bert-large": dict(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16),
+}
+
+
+def bert_config(preset: str = "bert-base", **overrides) -> BertConfig:
+    kw = dict(BERT_PRESETS[preset])
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+# --------------------------------------------------------------------------- #
+def _init_block(cfg: BertConfig, rng: Array) -> Dict:
+    E, I = cfg.hidden_size, cfg.ffn
+    ks = jax.random.split(rng, 4)
+    scale = 0.02
+    return {
+        "qkv_w": _dense_init(ks[0], E, (E, 3 * E), scale=scale),
+        "qkv_b": jnp.zeros((3 * E,), jnp.float32),
+        "out_w": _dense_init(ks[1], E, (E, E), scale=scale),
+        "out_b": jnp.zeros((E,), jnp.float32),
+        "ln1_g": jnp.ones((E,), jnp.float32),
+        "ln1_b": jnp.zeros((E,), jnp.float32),
+        "fc_w": _dense_init(ks[2], E, (E, I), scale=scale),
+        "fc_b": jnp.zeros((I,), jnp.float32),
+        "proj_w": _dense_init(ks[3], I, (I, E), scale=scale),
+        "proj_b": jnp.zeros((E,), jnp.float32),
+        "ln2_g": jnp.ones((E,), jnp.float32),
+        "ln2_b": jnp.zeros((E,), jnp.float32),
+    }
+
+
+def init_bert_params(cfg: BertConfig, rng: Array) -> Dict:
+    ks = jax.random.split(rng, 5)
+    E, L = cfg.hidden_size, cfg.num_hidden_layers
+    if cfg.scan_layers:
+        blocks = jax.vmap(partial(_init_block, cfg))(jax.random.split(ks[0], L))
+    else:
+        blocks = {f"h{i}": _init_block(cfg, k)
+                  for i, k in enumerate(jax.random.split(ks[0], L))}
+    return {
+        "wte": _dense_init(ks[1], cfg.padded_vocab, (cfg.padded_vocab, E)),
+        "wpe": _dense_init(ks[2], cfg.max_position_embeddings,
+                           (cfg.max_position_embeddings, E), scale=0.01),
+        "wtt": _dense_init(ks[3], cfg.type_vocab_size,
+                           (cfg.type_vocab_size, E), scale=0.01),
+        "ln_emb_g": jnp.ones((E,), jnp.float32),
+        "ln_emb_b": jnp.zeros((E,), jnp.float32),
+        "blocks": blocks,
+        # MLM transform head (dense + LN; decoder tied to wte)
+        "mlm_w": _dense_init(ks[4], E, (E, E)),
+        "mlm_b": jnp.zeros((E,), jnp.float32),
+        "ln_mlm_g": jnp.ones((E,), jnp.float32),
+        "ln_mlm_b": jnp.zeros((E,), jnp.float32),
+    }
+
+
+_BLOCK_SPECS = {
+    "qkv_w": PartitionSpec(None, "tensor"), "qkv_b": PartitionSpec("tensor"),
+    "out_w": PartitionSpec("tensor", None), "out_b": PartitionSpec(),
+    "ln1_g": PartitionSpec(), "ln1_b": PartitionSpec(),
+    "fc_w": PartitionSpec(None, "tensor"), "fc_b": PartitionSpec("tensor"),
+    "proj_w": PartitionSpec("tensor", None), "proj_b": PartitionSpec(),
+    "ln2_g": PartitionSpec(), "ln2_b": PartitionSpec(),
+}
+
+
+def bert_partition_specs(cfg: BertConfig) -> Dict:
+    def block_specs(stacked: bool):
+        pre = (None,) if stacked else ()
+        return {k: PartitionSpec(*pre, *s) for k, s in _BLOCK_SPECS.items()}
+
+    blocks = (block_specs(True) if cfg.scan_layers
+              else {f"h{i}": block_specs(False)
+                    for i in range(cfg.num_hidden_layers)})
+    return {
+        "wte": PartitionSpec("tensor", None),
+        "wpe": PartitionSpec(), "wtt": PartitionSpec(),
+        "ln_emb_g": PartitionSpec(), "ln_emb_b": PartitionSpec(),
+        "blocks": blocks,
+        "mlm_w": PartitionSpec(), "mlm_b": PartitionSpec(),
+        "ln_mlm_g": PartitionSpec(), "ln_mlm_b": PartitionSpec(),
+    }
+
+
+# --------------------------------------------------------------------------- #
+def bert_block(cfg: BertConfig, p: Dict, x: Array,
+               attention_fn: Callable, rng: Optional[Array] = None,
+               train: bool = False) -> Array:
+    """Post-LN (or pre-LN) bidirectional encoder block."""
+    B, S, E = x.shape
+    H, D = cfg.num_attention_heads, cfg.head_dim
+    dt = x.dtype
+    r = (jax.random.split(rng, 2) if rng is not None else (None, None))
+    drop = lambda h, k: _dropout(h, cfg.hidden_dropout_prob, k, train)
+
+    def attn(h):
+        qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = _constrain(q.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
+        k = _constrain(k.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
+        v = _constrain(v.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
+        o = attention_fn(q, k, v, causal=False).reshape(B, S, E)
+        return o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+
+    def mlp(h):
+        h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
+        h = _activation(h, "gelu")
+        return h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+
+    if cfg.pre_ln:
+        x = x + drop(attn(layer_norm(x, p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)), r[0])
+        x = x + drop(mlp(layer_norm(x, p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)), r[1])
+    else:
+        x = layer_norm(x + drop(attn(x), r[0]), p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)
+        x = layer_norm(x + drop(mlp(x), r[1]), p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)
+    return _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+
+
+def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
+                token_type_ids: Optional[Array] = None,
+                attention_fn: Optional[Callable] = None,
+                rng: Optional[Array] = None, train: bool = False) -> Array:
+    """Hidden states [B, S, E]."""
+    from deepspeed_tpu.ops.attention import get_attention_fn
+    attention_fn = attention_fn or get_attention_fn(cfg.attn_impl)
+    B, S = input_ids.shape
+    dt = cfg.dtype
+    use_rngs = rng is not None and train
+    with jax.named_scope("embed"):
+        x = params["wte"].astype(dt)[input_ids]
+        x = x + params["wpe"].astype(dt)[:S][None]
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(input_ids))
+        x = x + params["wtt"].astype(dt)[tt]
+        x = layer_norm(x, params["ln_emb_g"], params["ln_emb_b"], eps=cfg.ln_eps)
+        x = _dropout(x, cfg.hidden_dropout_prob, rng, train)
+        x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+
+    body = partial(bert_block, cfg, attention_fn=attention_fn, train=train)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    if cfg.scan_layers:
+        L = cfg.num_hidden_layers
+        rngs = (jax.random.split(jax.random.fold_in(rng, 7), L) if use_rngs
+                else jnp.zeros((L, 2), jnp.uint32))
+
+        def scan_body(x, layer):
+            p, r = layer
+            return body(p, x, rng=r if use_rngs else None), None
+        with jax.named_scope("blocks"):
+            x, _ = jax.lax.scan(scan_body, x, (params["blocks"], rngs))
+    else:
+        for i in range(cfg.num_hidden_layers):
+            r = jax.random.fold_in(rng, i) if use_rngs else None
+            x = body(params["blocks"][f"h{i}"], x, rng=r)
+    return x
+
+
+def bert_mlm_loss(cfg: BertConfig, params: Dict, input_ids: Array,
+                  labels: Array, token_type_ids: Optional[Array] = None,
+                  attention_fn: Optional[Callable] = None,
+                  rng: Optional[Array] = None, train: bool = False) -> Array:
+    """Masked-LM loss; positions with ``labels == -100`` are ignored
+    (HF convention)."""
+    x = bert_encode(cfg, params, input_ids, token_type_ids, attention_fn,
+                    rng=rng, train=train)
+    dt = cfg.dtype
+    with jax.named_scope("mlm_head"):
+        h = x @ params["mlm_w"].astype(dt) + params["mlm_b"].astype(dt)
+        h = _activation(h, "gelu")
+        h = layer_norm(h, params["ln_mlm_g"], params["ln_mlm_b"], eps=cfg.ln_eps)
+        logits = (h @ params["wte"].astype(dt).T).astype(jnp.float32)
+        # padded vocab rows never win
+        pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
+        logits = jnp.where(pad_mask[None, None], -1e30, logits)
+    valid = labels != -100
+    tgt = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+
+
+class Bert:
+    """Engine-compatible model object (callable convention
+    ``fn(params, batch, rng, train) -> loss``)."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+
+    def __call__(self, params, batch, rng, train, **_ignored):
+        if len(batch) == 3:
+            input_ids, token_type_ids, labels = batch
+        else:
+            input_ids, labels = batch
+            token_type_ids = None
+        return bert_mlm_loss(self.cfg, params, input_ids, labels,
+                             token_type_ids, rng=rng, train=train)
+
+    def init_params(self, rng):
+        return init_bert_params(self.cfg, rng)
+
+    def partition_specs(self):
+        return bert_partition_specs(self.cfg)
+
+    def forward_hidden(self, params, input_ids, token_type_ids=None):
+        return bert_encode(self.cfg, params, input_ids, token_type_ids)

@@ -70,6 +70,15 @@ class GPTConfig:
     # non-scan path only: which block ids drop tokens (None = all); the
     # homogeneous scan path applies LTD to every block when enabled
     ltd_layers: Optional[Tuple[int, ...]] = None
+    # --- architecture family knobs (one fused block serves GPT-2, BLOOM
+    # (alibi), and LLaMA-style (rope+rmsnorm+swiglu) — the same strategy as
+    # the reference's per-arch ds_* model_implementations variants) ------- #
+    position_encoding: str = "learned"   # 'learned' | 'rope' | 'alibi'
+    norm: str = "layernorm"              # 'layernorm' | 'rmsnorm'
+    mlp_type: str = "standard"           # 'standard' | 'swiglu'
+    intermediate_size: Optional[int] = None   # default 4*n_embd
+    use_bias: bool = True                # LLaMA-style blocks are bias-free
+    rope_theta: float = 10000.0
     # pad vocab to a multiple (MXU-friendly, and divisible by tensor axis)
     vocab_multiple: int = 128
 
@@ -78,6 +87,10 @@ class GPTConfig:
             math.ceil(self.vocab_size / self.vocab_multiple) * self.vocab_multiple)
         assert self.n_embd % self.n_head == 0
         self.head_dim = self.n_embd // self.n_head
+        self.ffn_dim = self.intermediate_size or 4 * self.n_embd
+        assert self.position_encoding in ("learned", "rope", "alibi")
+        assert self.norm in ("layernorm", "rmsnorm")
+        assert self.mlp_type in ("standard", "swiglu")
 
 
 # Model zoo (GPT-2 sizes; the 1.5B "xl" is the north-star model).
@@ -96,6 +109,31 @@ def gpt_config(preset: str = "gpt2", **overrides) -> GPTConfig:
     return GPTConfig(**kw)
 
 
+def llama_config(vocab_size=32000, n_positions=2048, n_embd=512, n_layer=4,
+                 n_head=8, intermediate_size=None, **overrides) -> GPTConfig:
+    """LLaMA-style family: RoPE + RMSNorm + SwiGLU, bias-free, untied
+    head (the reference serves these via its llama containers)."""
+    kw = dict(vocab_size=vocab_size, n_positions=n_positions, n_embd=n_embd,
+              n_layer=n_layer, n_head=n_head,
+              position_encoding="rope", norm="rmsnorm", mlp_type="swiglu",
+              use_bias=False, untied_head=True,
+              intermediate_size=intermediate_size or int(n_embd * 8 / 3),
+              activation="gelu")
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+def bloom_config(vocab_size=250880, n_positions=2048, n_embd=512, n_layer=4,
+                 n_head=8, **overrides) -> GPTConfig:
+    """BLOOM family: ALiBi positions, GELU MLP, tied embeddings
+    (reference ``model_implementations/transformers/ds_bloom.py``)."""
+    kw = dict(vocab_size=vocab_size, n_positions=n_positions, n_embd=n_embd,
+              n_layer=n_layer, n_head=n_head,
+              position_encoding="alibi", activation="gelu_tanh")
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
 # --------------------------------------------------------------------------- #
 # Parameter construction / partition specs
 # --------------------------------------------------------------------------- #
@@ -106,7 +144,8 @@ def _dense_init(rng, fan_in, shape, scale=0.02):
 def _init_block(cfg: GPTConfig, rng: Array) -> Dict:
     """One transformer block's params (GPT-2 init: residual projections
     scaled by 1/sqrt(2L))."""
-    E = cfg.n_embd
+    E, I = cfg.n_embd, cfg.ffn_dim
+    fc_out = 2 * I if cfg.mlp_type == "swiglu" else I   # swiglu fuses gate|up
     proj_scale = 0.02 / math.sqrt(2 * cfg.n_layer)
     ks = jax.random.split(rng, 4)
     return {
@@ -118,18 +157,20 @@ def _init_block(cfg: GPTConfig, rng: Array) -> Dict:
         "out_b": jnp.zeros((E,), jnp.float32),
         "ln2_g": jnp.ones((E,), jnp.float32),
         "ln2_b": jnp.zeros((E,), jnp.float32),
-        "fc_w": _dense_init(ks[2], E, (E, 4 * E)),
-        "fc_b": jnp.zeros((4 * E,), jnp.float32),
-        "proj_w": _dense_init(ks[3], 4 * E, (4 * E, E), scale=proj_scale),
+        "fc_w": _dense_init(ks[2], E, (E, fc_out)),
+        "fc_b": jnp.zeros((fc_out,), jnp.float32),
+        "proj_w": _dense_init(ks[3], I, (I, E), scale=proj_scale),
         "proj_b": jnp.zeros((E,), jnp.float32),
     }
 
 
 def _init_embed(cfg: GPTConfig, rng: Array) -> Dict:
     ks = jax.random.split(rng, 2)
-    return {"wte": _dense_init(ks[0], cfg.padded_vocab, (cfg.padded_vocab, cfg.n_embd)),
-            "wpe": _dense_init(ks[1], cfg.n_positions, (cfg.n_positions, cfg.n_embd),
-                               scale=0.01)}
+    out = {"wte": _dense_init(ks[0], cfg.padded_vocab, (cfg.padded_vocab, cfg.n_embd))}
+    if cfg.position_encoding == "learned":
+        out["wpe"] = _dense_init(ks[1], cfg.n_positions,
+                                 (cfg.n_positions, cfg.n_embd), scale=0.01)
+    return out
 
 
 def init_gpt_params(cfg: GPTConfig, rng: Array) -> Dict:
@@ -146,11 +187,12 @@ def init_gpt_params(cfg: GPTConfig, rng: Array) -> Dict:
     embed = _init_embed(cfg, k_embed)
     params = {
         "wte": embed["wte"],
-        "wpe": embed["wpe"],
         "blocks": blocks,
         "lnf_g": jnp.ones((E,), jnp.float32),
         "lnf_b": jnp.zeros((E,), jnp.float32),
     }
+    if "wpe" in embed:
+        params["wpe"] = embed["wpe"]
     if cfg.untied_head:
         params["lm_head"] = _dense_init(
             jax.random.fold_in(k_embed, 2), E, (cfg.padded_vocab, E))
@@ -186,11 +228,12 @@ def gpt_partition_specs(cfg: GPTConfig) -> Dict:
         blocks = {f"h{i}": block_specs(False) for i in range(cfg.n_layer)}
     specs = {
         "wte": PartitionSpec("tensor", None),   # vocab-parallel embedding
-        "wpe": PartitionSpec(),
         "blocks": blocks,
         "lnf_g": PartitionSpec(),
         "lnf_b": PartitionSpec(),
     }
+    if cfg.position_encoding == "learned":
+        specs["wpe"] = PartitionSpec()
     if cfg.untied_head:
         specs["lm_head"] = PartitionSpec("tensor", None)
     return specs
@@ -210,6 +253,46 @@ def _activation(x: Array, kind: str) -> Array:
     if kind == "relu":
         return jax.nn.relu(x)
     raise ValueError(f"unknown activation {kind!r}")
+
+
+def rms_norm(x: Array, g: Array, eps: float = 1e-5) -> Array:
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    return (xf * g.astype(jnp.float32)).astype(x.dtype)
+
+
+def _norm(cfg: "GPTConfig", x: Array, g: Array, b: Array) -> Array:
+    if cfg.norm == "rmsnorm":
+        return rms_norm(x, g, eps=cfg.ln_eps)
+    return layer_norm(x, g, b, eps=cfg.ln_eps)
+
+
+def apply_rope(x: Array, positions: Array, theta: float = 10000.0) -> Array:
+    """Rotary position embedding on [B, S, H, D] (LLaMA-style pairing)."""
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = (1.0 / theta) ** (jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None]   # [S, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _mlp(cfg: "GPTConfig", p: Dict, h: Array, dt) -> Array:
+    up = h @ p["fc_w"].astype(dt)
+    if cfg.use_bias:
+        up = up + p["fc_b"].astype(dt)
+    if cfg.mlp_type == "swiglu":
+        gate, val = jnp.split(up, 2, axis=-1)
+        h = jax.nn.silu(gate) * val
+    else:
+        h = _activation(up, cfg.activation)
+    out = h @ p["proj_w"].astype(dt)
+    if cfg.use_bias:
+        out = out + p["proj_b"].astype(dt)
+    return out
 
 
 def layer_norm(x: Array, g: Array, b: Array, eps: float = 1e-5) -> Array:
@@ -237,27 +320,37 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
     r = (jax.random.split(rng, 3) if rng is not None else (None, None, None))
 
     with jax.named_scope("attn"):
-        h = layer_norm(x, p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)
-        qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
+        h = _norm(cfg, x, p["ln1_g"], p["ln1_b"])
+        qkv = h @ p["qkv_w"].astype(dt)
+        if cfg.use_bias:
+            qkv = qkv + p["qkv_b"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, D)
         k = k.reshape(B, S, H, D)
         v = v.reshape(B, S, H, D)
+        if cfg.position_encoding == "rope":
+            pos = jnp.arange(S)
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
         # heads sharded over tensor axis (Megatron attention parallelism)
         q = _constrain(q, mesh_lib.BATCH_AXES, "seq", "tensor", None)
         k = _constrain(k, mesh_lib.BATCH_AXES, "seq", "tensor", None)
         v = _constrain(v, mesh_lib.BATCH_AXES, "seq", "tensor", None)
-        o = attention_fn(q, k, v, causal=True)
+        if cfg.position_encoding == "alibi":
+            from deepspeed_tpu.ops.attention import alibi_bias
+            o = attention_fn(q, k, v, causal=True, bias=alibi_bias(H, S, S))
+        else:
+            o = attention_fn(q, k, v, causal=True)
         o = o.reshape(B, S, E)
-        o = o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+        o = o @ p["out_w"].astype(dt)
+        if cfg.use_bias:
+            o = o + p["out_b"].astype(dt)
         x = x + _dropout(o, cfg.dropout, r[0], train)
         x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
 
     with jax.named_scope("mlp"):
-        h = layer_norm(x, p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)
-        h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
-        h = _activation(h, cfg.activation)
-        h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+        h = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+        h = _mlp(cfg, p, h, dt)
         x = x + _dropout(h, cfg.dropout, r[1], train)
     return _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
 
@@ -282,7 +375,9 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
     B, S = input_ids.shape
     dt = cfg.dtype
     with jax.named_scope("embed"):
-        x = params["wte"].astype(dt)[input_ids] + params["wpe"].astype(dt)[:S][None]
+        x = params["wte"].astype(dt)[input_ids]
+        if cfg.position_encoding == "learned":
+            x = x + params["wpe"].astype(dt)[:S][None]
         x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
         x = _dropout(x, cfg.dropout, rng, train)
 
@@ -344,7 +439,7 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
                 x = run(x)
 
     with jax.named_scope("head"):
-        x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
+        x = _norm(cfg, x, params["lnf_g"], params["lnf_b"])
         # tied embedding projection (or the untied lm_head when the source
         # checkpoint has one); vocab-parallel → logits sharded over tensor
         head = params["lm_head"] if cfg.untied_head else params["wte"]
@@ -378,15 +473,18 @@ def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict:
             "pos": jnp.zeros((), jnp.int32)}
 
 
-def _cached_attention(q, ck, cv, pos):
+def _cached_attention(q, ck, cv, pos, bias=None):
     """q: [B, S_q, H, D] attends causally to cache positions <= its own
     global position (query i sits at ``pos + i``).  Static shapes:
-    full-cache attention with masking — the standard TPU decode pattern."""
+    full-cache attention with masking — the standard TPU decode pattern.
+    ``bias``: additive [1, H, S_q, T] logit bias (ALiBi)."""
     B, Sq, H, D = q.shape
     T = ck.shape[1]
     scale = 1.0 / np.sqrt(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    ck.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     kpos = jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 1)
     qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 0)
     mask = kpos <= qpos
@@ -408,31 +506,49 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
     pos = cache["pos"]
 
     x = params["wte"].astype(dt)[input_ids]
-    x = x + params["wpe"].astype(dt)[jnp.clip(pos + jnp.arange(S), 0,
-                                              cfg.n_positions - 1)][None]
+    if cfg.position_encoding == "learned":
+        x = x + params["wpe"].astype(dt)[jnp.clip(pos + jnp.arange(S), 0,
+                                                  cfg.n_positions - 1)][None]
     x = _constrain(x, mesh_lib.BATCH_AXES, None, None)
+
+    T = cache["k"].shape[2]
+    if cfg.position_encoding == "alibi":
+        from deepspeed_tpu.ops.attention import alibi_slopes
+        slopes = jnp.asarray(alibi_slopes(H))
+        kpos = jnp.arange(T)[None, :]
+        qpos = (pos + jnp.arange(S))[:, None]
+        attn_bias = (slopes[:, None, None]
+                     * (kpos - qpos).astype(jnp.float32))[None]
+    else:
+        attn_bias = None
 
     def layer(x, layer_in):
         p, ck, cv = layer_in
-        h = layer_norm(x, p["ln1_g"], p["ln1_b"], eps=cfg.ln_eps)
-        qkv = h @ p["qkv_w"].astype(dt) + p["qkv_b"].astype(dt)
+        h = _norm(cfg, x, p["ln1_g"], p["ln1_b"])
+        qkv = h @ p["qkv_w"].astype(dt)
+        if cfg.use_bias:
+            qkv = qkv + p["qkv_b"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, D)
         k = k.reshape(B, S, H, D)
         v = v.reshape(B, S, H, D)
+        if cfg.position_encoding == "rope":
+            rpos = pos + jnp.arange(S)
+            q = apply_rope(q, rpos, cfg.rope_theta)
+            k = apply_rope(k, rpos, cfg.rope_theta)
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=1)
-        o = _cached_attention(q, ck, cv, pos).reshape(B, S, E)
-        o = o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
+        o = _cached_attention(q, ck, cv, pos, bias=attn_bias).reshape(B, S, E)
+        o = o @ p["out_w"].astype(dt)
+        if cfg.use_bias:
+            o = o + p["out_b"].astype(dt)
         x = x + o
-        h = layer_norm(x, p["ln2_g"], p["ln2_b"], eps=cfg.ln_eps)
-        h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
-        h = _activation(h, cfg.activation)
-        h = h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
+        h = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+        h = _mlp(cfg, p, h, dt)
         return x + h, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["blocks"], cache["k"], cache["v"]))
-    x = layer_norm(x, params["lnf_g"], params["lnf_b"], eps=cfg.ln_eps)
+    x = _norm(cfg, x, params["lnf_g"], params["lnf_b"])
     head = params["lm_head"] if cfg.untied_head else params["wte"]
     logits = (x @ head.astype(dt).T).astype(jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "pos": pos + S}

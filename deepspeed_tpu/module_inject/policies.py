@@ -251,3 +251,159 @@ def policy_for_model(hf_model) -> Optional[InjectionPolicy]:
         if hf_config is not None and pol.matches(hf_config):
             return pol()
     return None
+
+
+class HFBloomPolicy(InjectionPolicy):
+    """HF BLOOM (reference ``module_inject/containers/bloom.py``).
+
+    BLOOM stores qkv INTERLEAVED per head ([H, 3, D] on the output dim) —
+    de-interleave into the fused [q|k|v] layout; positions are ALiBi (no
+    wpe); embeddings go through a dedicated LayerNorm folded in by
+    pre-norming wte here is NOT possible, so word_embeddings_layernorm is
+    REQUIRED to be foldable: it is applied to the embedding output, which
+    equals scaling rows of wte only for LayerNorm without cross-feature
+    stats — so we keep it as explicit extra params consumed by... instead
+    we fold it by materializing normed embeddings: wte' = LN(wte), exact
+    because LN acts row-wise on the embedding table lookup output.
+    """
+
+    model_types = ("bloom",)
+
+    def build(self, hf_model):
+        hc = hf_model.config
+        from deepspeed_tpu.models.gpt import bloom_config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        head = _untied_head(hc, sd, "lm_head.weight")
+        cfg = bloom_config(vocab_size=hc.vocab_size,
+                           n_positions=getattr(hc, "seq_length", 2048),
+                           n_embd=hc.hidden_size, n_layer=hc.n_layer,
+                           n_head=hc.n_head, ln_eps=hc.layer_norm_epsilon,
+                           untied_head=head is not None)
+        pre = "transformer."
+        E, H = cfg.n_embd, cfg.n_head
+        D = E // H
+
+        def deinterleave(w):                    # [E, H*3*D] <- [3E(out), E].T
+            w = w.T.reshape(E, H, 3, D)
+            return jnp_concat([w[:, :, i].reshape(E, E) for i in range(3)])
+
+        def jnp_concat(parts):
+            return np.concatenate(parts, axis=1)
+
+        def deinterleave_b(b):
+            b = b.reshape(H, 3, D)
+            return np.concatenate([b[:, i].reshape(E) for i in range(3)])
+
+        blocks = []
+        for i in range(cfg.n_layer):
+            b = f"{pre}h.{i}."
+            blocks.append({
+                "ln1_g": sd[b + "input_layernorm.weight"],
+                "ln1_b": sd[b + "input_layernorm.bias"],
+                "qkv_w": deinterleave(sd[b + "self_attention.query_key_value.weight"]),
+                "qkv_b": deinterleave_b(sd[b + "self_attention.query_key_value.bias"]),
+                "out_w": sd[b + "self_attention.dense.weight"].T,
+                "out_b": sd[b + "self_attention.dense.bias"],
+                "ln2_g": sd[b + "post_attention_layernorm.weight"],
+                "ln2_b": sd[b + "post_attention_layernorm.bias"],
+                "fc_w": sd[b + "mlp.dense_h_to_4h.weight"].T,
+                "fc_b": sd[b + "mlp.dense_h_to_4h.bias"],
+                "proj_w": sd[b + "mlp.dense_4h_to_h.weight"].T,
+                "proj_b": sd[b + "mlp.dense_4h_to_h.bias"],
+            })
+        # fold the word-embedding LayerNorm into the table (row-wise exact)
+        wte = sd[pre + "word_embeddings.weight"]
+        g = sd[pre + "word_embeddings_layernorm.weight"]
+        bb = sd[pre + "word_embeddings_layernorm.bias"]
+        mu = wte.mean(axis=1, keepdims=True)
+        var = wte.var(axis=1, keepdims=True)
+        wte_normed = (wte - mu) / np.sqrt(var + hc.layer_norm_epsilon) * g + bb
+        params = {
+            "wte": _pad_vocab(wte_normed, cfg.padded_vocab),
+            "blocks": _stack(blocks),
+            "lnf_g": sd[pre + "ln_f.weight"],
+            "lnf_b": sd[pre + "ln_f.bias"],
+        }
+        if head is not None:
+            params["lm_head"] = _pad_vocab(head, cfg.padded_vocab)
+        else:
+            # BLOOM ties the head to the RAW embedding table, which we
+            # replaced by the normed one — carry the raw table as the head
+            params["lm_head"] = _pad_vocab(wte, cfg.padded_vocab)
+            cfg = _with(cfg, untied_head=True)
+        return cfg, params
+
+
+class HFLlamaPolicy(InjectionPolicy):
+    """HF LLaMA-family (reference llama containers): separate bias-free
+    q/k/v, RoPE, RMSNorm, SwiGLU gate/up fused into fc_w."""
+
+    model_types = ("llama",)
+
+    def build(self, hf_model):
+        hc = hf_model.config
+        assert getattr(hc, "num_key_value_heads", hc.num_attention_heads) \
+            == hc.num_attention_heads, "GQA/MQA not supported by the fused block yet"
+        from deepspeed_tpu.models.gpt import llama_config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        head = _untied_head(hc, sd, "lm_head.weight")
+        cfg = llama_config(vocab_size=hc.vocab_size,
+                           n_positions=hc.max_position_embeddings,
+                           n_embd=hc.hidden_size, n_layer=hc.num_hidden_layers,
+                           n_head=hc.num_attention_heads,
+                           intermediate_size=hc.intermediate_size,
+                           ln_eps=hc.rms_norm_eps,
+                           rope_theta=getattr(hc, "rope_theta", 10000.0),
+                           untied_head=True)
+        pre = "model."
+        E = cfg.n_embd
+        blocks = []
+        for i in range(cfg.n_layer):
+            b = f"{pre}layers.{i}."
+            qkv_w = np.concatenate(
+                [_rope_permute(sd[b + f"self_attn.{n}_proj.weight"],
+                               cfg.n_head).T for n in ("q", "k")]
+                + [sd[b + "self_attn.v_proj.weight"].T], axis=1)
+            blocks.append({
+                "ln1_g": sd[b + "input_layernorm.weight"],
+                "ln1_b": np.zeros((E,), np.float32),
+                "qkv_w": qkv_w,
+                "qkv_b": np.zeros((3 * E,), np.float32),
+                "out_w": sd[b + "self_attn.o_proj.weight"].T,
+                "out_b": np.zeros((E,), np.float32),
+                "ln2_g": sd[b + "post_attention_layernorm.weight"],
+                "ln2_b": np.zeros((E,), np.float32),
+                "fc_w": np.concatenate([sd[b + "mlp.gate_proj.weight"].T,
+                                        sd[b + "mlp.up_proj.weight"].T], axis=1),
+                "fc_b": np.zeros((2 * cfg.ffn_dim,), np.float32),
+                "proj_w": sd[b + "mlp.down_proj.weight"].T,
+                "proj_b": np.zeros((E,), np.float32),
+            })
+        params = {
+            "wte": _pad_vocab(sd[pre + "embed_tokens.weight"], cfg.padded_vocab),
+            "blocks": _stack(blocks),
+            "lnf_g": sd[pre + "norm.weight"],
+            "lnf_b": np.zeros((E,), np.float32),
+        }
+        params["lm_head"] = _pad_vocab(
+            head if head is not None else sd[pre + "embed_tokens.weight"],
+            cfg.padded_vocab)
+        return cfg, params
+
+
+def _rope_permute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """HF llama stores rope dims interleaved-halved per head relative to
+    the classic (x1|x2) pairing this repo's apply_rope uses: permute
+    [out, in] rows head-wise from (0,2,4,...,1,3,5...) HF layout back."""
+    out, inp = w.shape
+    D = out // n_head
+    w = w.reshape(n_head, 2, D // 2, inp)
+    return w.transpose(0, 2, 1, 3).reshape(out, inp)
+
+
+def _with(cfg, **kw):
+    import dataclasses
+    return dataclasses.replace(cfg, **kw)
+
+
+_POLICIES = _POLICIES + (HFBloomPolicy, HFLlamaPolicy)

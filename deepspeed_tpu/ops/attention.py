@@ -11,7 +11,13 @@ compares fused CUDA vs HF modeling.
 
 All implementations share one signature::
 
-    fn(q, k, v, *, causal: bool) -> out     # [batch, seq, heads, head_dim]
+    fn(q, k, v, *, causal: bool, bias=None) -> out   # [batch, seq, heads, head_dim]
+
+``bias`` is an additive attention-logit bias broadcastable to
+``[batch, heads, q, k]`` (ALiBi slopes, relative-position bias).  The
+Pallas kernel path handles the un-biased case; biased calls take the jnp
+path, which XLA fuses (the reference's alibi similarly lives in its own
+softmax kernel variant).
 """
 
 from functools import partial
@@ -23,11 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def reference_attention(q, k, v, *, causal: bool = True):
+def reference_attention(q, k, v, *, causal: bool = True, bias=None):
     """Pure-jnp multi-head attention, fp32 softmax accumulation."""
     B, S, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
@@ -42,27 +50,52 @@ def _on_tpu() -> bool:
         return False
 
 
-def flash_attention(q, k, v, *, causal: bool = True):
+def flash_attention(q, k, v, *, causal: bool = True, bias=None):
     """Pallas flash attention on TPU; falls back to the reference path on
-    other backends (tests run on the CPU mesh)."""
-    if not _on_tpu():
-        return reference_attention(q, k, v, causal=causal)
+    other backends (tests run on the CPU mesh) and for biased calls."""
+    if bias is not None or not _on_tpu():
+        return reference_attention(q, k, v, causal=causal, bias=bias)
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention as fa
     return fa(q, k, v, causal=causal)
 
 
-def ring_attention(q, k, v, *, causal: bool = True):
+def ring_attention(q, k, v, *, causal: bool = True, bias=None):
+    assert bias is None, "ring attention does not support logit bias yet"
     """Ring attention over the ``seq`` mesh axis (KV blocks rotated by
     ppermute); see ``deepspeed_tpu/parallel/sequence.py``."""
     from deepspeed_tpu.parallel.sequence import ring_attention as ra
     return ra(q, k, v, causal=causal)
 
 
-def ulysses_attention(q, k, v, *, causal: bool = True):
+def ulysses_attention(q, k, v, *, causal: bool = True, bias=None):
+    assert bias is None, "ulysses attention does not support logit bias yet"
     """Ulysses-style all-to-all sequence parallel attention; see
     ``deepspeed_tpu/parallel/sequence.py``."""
     from deepspeed_tpu.parallel.sequence import ulysses_attention as ua
     return ua(q, k, v, causal=causal, inner=flash_attention)
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (BLOOM; geometric sequence from the paper)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if np.log2(num_heads).is_integer():
+        return np.asarray(pow2_slopes(num_heads), np.float32)
+    closest = 2 ** int(np.floor(np.log2(num_heads)))
+    extra = pow2_slopes(2 * closest)[0::2][:num_heads - closest]
+    return np.asarray(pow2_slopes(closest) + extra, np.float32)
+
+
+def alibi_bias(num_heads: int, q_len: int, k_len: int,
+               q_offset: int = 0) -> jnp.ndarray:
+    """[1, H, q, k] additive ALiBi bias: slope_h * -(q_pos - k_pos)."""
+    slopes = jnp.asarray(alibi_slopes(num_heads))
+    qpos = q_offset + jnp.arange(q_len)[:, None]
+    kpos = jnp.arange(k_len)[None, :]
+    dist = (kpos - qpos).astype(jnp.float32)        # <= 0 in the causal past
+    return (slopes[:, None, None] * dist)[None]
 
 
 _REGISTRY = {

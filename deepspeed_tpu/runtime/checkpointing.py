@@ -83,6 +83,16 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
             json.dump(meta, f)
+        # recovery script rides along with every checkpoint (reference
+        # engine.py:3125 copies utils/zero_to_fp32.py into the ckpt dir)
+        try:
+            import shutil
+
+            from deepspeed_tpu.utils import zero_to_fp32 as _z2f
+            shutil.copyfile(_z2f.__file__,
+                            os.path.join(save_dir, "zero_to_fp32.py"))
+        except OSError as e:
+            logger.warning(f"could not copy zero_to_fp32.py: {e}")
 
     def _finalize():
         # commit is the durability barrier; only a durable checkpoint may

@@ -1,0 +1,75 @@
+"""Sparse gradient representation (embedding gradients).
+
+Reference: ``deepspeed/runtime/sparse_tensor.py`` (``SparseTensor``:
+index/value pairs so a sparse-gradient embedding's allreduce moves only
+touched rows, ``engine.py:sparse_allreduce:2316``).
+
+TPU recast: a row-sparse (indices, values) pair over dim 0 with
+``to_dense`` / ``from_dense`` / ``add`` / ``allreduce`` — the collective
+exchanges only the gathered (index, value) payloads.  XLA scatters/adds
+on device; duplicate indices accumulate.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """Row-sparse tensor: ``values[i]`` is the row at ``indices[i]``."""
+
+    def __init__(self, indices: jax.Array, values: jax.Array,
+                 dense_size: Tuple[int, ...]):
+        assert values.ndim >= 1 and indices.ndim == 1
+        self.indices = indices
+        self.values = values
+        self.dense_size = tuple(dense_size)
+
+    # ---- constructors -------------------------------------------------- #
+    @staticmethod
+    def from_dense(dense: jax.Array, max_rows: Optional[int] = None) -> "SparseTensor":
+        """Rows with any nonzero become (index, value) pairs.  ``max_rows``
+        bounds the payload (jit needs static shapes); rows beyond it are
+        dropped largest-index-first."""
+        nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+        k = int(max_rows or dense.shape[0])
+        # stable selection: present rows keep their index, absent sort last
+        order = jnp.where(nz, jnp.arange(dense.shape[0]), dense.shape[0])
+        picked = jnp.sort(order)[:k]
+        valid = picked < dense.shape[0]
+        idx = jnp.where(valid, picked, 0)     # padding reads row 0...
+        vals = dense[idx] * valid[..., None].astype(dense.dtype)  # ...zeroed
+        return SparseTensor(idx, vals, dense.shape)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    # ---- arithmetic ---------------------------------------------------- #
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        assert self.dense_size == other.dense_size
+        return SparseTensor(jnp.concatenate([self.indices, other.indices]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.dense_size)
+
+    def scale(self, s) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values * s, self.dense_size)
+
+    # ---- collective ---------------------------------------------------- #
+    def allreduce(self, axis_name: str) -> "SparseTensor":
+        """Mean over a mesh axis moving only the sparse payload (reference
+        ``sparse_allreduce``: all_gather of indices+values, not the dense
+        matrix).  Call inside shard_map."""
+        world = jax.lax.axis_size(axis_name)
+        idx = jax.lax.all_gather(self.indices, axis_name).reshape(-1)
+        vals = jax.lax.all_gather(self.values, axis_name)
+        vals = vals.reshape(-1, *self.values.shape[1:]) / world
+        return SparseTensor(idx, vals, self.dense_size)
+
+    def sparse_size(self) -> int:
+        return int(self.values.size + self.indices.size)
+
+    def __repr__(self):
+        return (f"SparseTensor(rows={self.indices.shape[0]}, "
+                f"dense_size={self.dense_size})")

@@ -1,0 +1,67 @@
+"""Abstract ("meta"-device) parameter initialization.
+
+Reference: ``deepspeed/utils/init_on_device.py:12`` (``OnDevice``: a
+context that redirects tensor construction onto a target/meta device so
+huge models can be described without materializing).
+
+JAX recast: abstract construction IS a first-class operation —
+``jax.eval_shape`` runs any init function with zero FLOPs and zero bytes,
+returning a ShapeDtypeStruct pytree.  ``OnDevice(device='meta')`` wraps
+that; with a real device it materializes via ``jax.jit`` with
+``out_shardings`` so parameters are born sharded (the zero.Init
+construction path uses the same mechanism,
+``runtime/zero/partition_parameters.py``).
+"""
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+
+
+class OnDevice:
+    """``with OnDevice(dtype, device="meta"): params = init(...)`` — usable
+    either as a context manager exposing :meth:`init` or directly as a
+    callable wrapper."""
+
+    _active: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        OnDevice._active = self if self.enabled else None
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = None
+        return False
+
+    # ------------------------------------------------------------------ #
+    def init(self, init_fn: Callable, *args, shardings=None, **kwargs) -> Any:
+        """Run ``init_fn`` under this context's device policy."""
+        if not self.enabled:
+            return init_fn(*args, **kwargs)
+        if self.device == "meta":
+            out = jax.eval_shape(init_fn, *args, **kwargs)
+        else:
+            jit_kwargs = {"out_shardings": shardings} if shardings is not None else {}
+            out = jax.jit(init_fn, **jit_kwargs)(*args, **kwargs)
+        if self.dtype is not None:
+            cast = (lambda s: jax.ShapeDtypeStruct(s.shape, self.dtype)
+                    if isinstance(s, jax.ShapeDtypeStruct)
+                    else s.astype(self.dtype))
+            out = jax.tree.map(cast, out)
+        return out
+
+    def __call__(self, init_fn: Callable) -> Callable:
+        def wrapped(*args, **kwargs):
+            return self.init(init_fn, *args, **kwargs)
+        return wrapped
+
+
+def abstract_init(init_fn: Callable, *args, **kwargs):
+    """Shorthand: ShapeDtypeStruct pytree of ``init_fn``'s output."""
+    return jax.eval_shape(init_fn, *args, **kwargs)

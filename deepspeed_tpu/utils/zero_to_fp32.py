@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Offline checkpoint → consolidated fp32 weights converter.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` (the standalone script
+copied into every checkpoint directory, ``engine.py:3125``): merge the
+per-rank ZeRO partitions of a saved checkpoint into one full fp32 state
+dict without needing the training cluster.
+
+TPU storage is one sharded orbax tree per tag, so "consolidation" is a
+plain host restore (tensorstore reassembles shards); this tool exists for
+the same workflow — grab full weights from a training checkpoint on any
+machine:
+
+    python zero_to_fp32.py <checkpoint_dir> <output_file> [--tag TAG]
+
+Output: ``.npz`` of flat-named fp32 arrays (and ``.pt`` when torch is
+importable and the output path ends with .pt).
+"""
+
+import argparse
+import os
+import sys
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: str = None) -> dict:
+    """Full fp32 {flat_name: np.ndarray} from a saved checkpoint."""
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            tags = sorted(d for d in os.listdir(checkpoint_dir)
+                          if os.path.isdir(os.path.join(checkpoint_dir, d)))
+            assert tags, f"no checkpoints under {checkpoint_dir}"
+            tag = tags[-1]
+    state_path = os.path.join(checkpoint_dir, str(tag), "state")
+    assert os.path.isdir(state_path), f"no checkpoint state at {state_path}"
+
+    restored = ocp.PyTreeCheckpointer().restore(state_path)
+    params = restored["params"]
+
+    out = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}{k}." if prefix else f"{k}.")
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{prefix}{i}.")
+        else:
+            out[prefix[:-1]] = np.asarray(node, np.float32)
+
+    walk(params, "")
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str,
+                                               tag: str = None):
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    if output_file.endswith(".pt"):
+        try:
+            import torch
+            torch.save({k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+                       output_file)
+            print(f"saved {len(sd)} tensors to {output_file} (torch)")
+            return
+        except ImportError:
+            output_file += ".npz"
+    import numpy as np
+    np.savez(output_file if output_file.endswith(".npz")
+             else output_file + ".npz", **sd)
+    print(f"saved {len(sd)} arrays to {output_file}")
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("--tag", default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -200,3 +200,52 @@ class TestInjectionFixes:
         ids = np.array([[5, 11]], np.int64)
         out = np.asarray(engine.forward(ids), np.float32)
         assert np.allclose(out, out[0, 0, 0])    # all-zero params → flat logits
+
+
+@pytest.fixture(scope="module")
+def tiny_bloom():
+    torch.manual_seed(4)
+    cfg = transformers.BloomConfig(vocab_size=97, hidden_size=32, n_layer=2,
+                                   n_head=4)
+    return transformers.BloomForCausalLM(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    torch.manual_seed(5)
+    cfg = transformers.LlamaConfig(vocab_size=97, hidden_size=32,
+                                   num_hidden_layers=2, num_attention_heads=4,
+                                   num_key_value_heads=4, intermediate_size=64,
+                                   max_position_embeddings=64)
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+IDS2 = np.array([[5, 11, 2, 7, 3, 1, 0, 9]], np.int64)
+
+
+class TestBloomInjection:
+    def test_logits_parity(self, tiny_bloom):
+        engine = deepspeed_tpu.init_inference(tiny_bloom, dtype="fp32")
+        ours = np.asarray(engine.forward(IDS2), np.float32)[:, :, :97]
+        ref = _hf_logits(tiny_bloom, IDS2)
+        np.testing.assert_allclose(ours, ref, atol=3e-3, rtol=3e-3)
+
+    def test_greedy_generate_parity(self, tiny_bloom):
+        engine = deepspeed_tpu.init_inference(tiny_bloom, dtype="fp32")
+        ours = np.asarray(engine.generate(IDS2, max_new_tokens=6))
+        ref = _hf_greedy(tiny_bloom, IDS2, 6)
+        np.testing.assert_array_equal(ours, ref)
+
+
+class TestLlamaInjection:
+    def test_logits_parity(self, tiny_llama):
+        engine = deepspeed_tpu.init_inference(tiny_llama, dtype="fp32")
+        ours = np.asarray(engine.forward(IDS2), np.float32)[:, :, :97]
+        ref = _hf_logits(tiny_llama, IDS2)
+        np.testing.assert_allclose(ours, ref, atol=3e-3, rtol=3e-3)
+
+    def test_greedy_generate_parity(self, tiny_llama):
+        engine = deepspeed_tpu.init_inference(tiny_llama, dtype="fp32")
+        ours = np.asarray(engine.generate(IDS2, max_new_tokens=6))
+        ref = _hf_greedy(tiny_llama, IDS2, 6)
+        np.testing.assert_array_equal(ours, ref)

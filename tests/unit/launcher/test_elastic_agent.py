@@ -1,0 +1,85 @@
+"""Elastic agent tests (reference ``tests/unit/elasticity`` agent paths:
+restart-on-failure, membership-change restart, env propagation) — all
+with local subprocesses, no real cluster."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, WorkerSpec
+
+ELASTIC_CFG = {"elasticity": {
+    "enabled": True, "max_train_batch_size": 64,
+    "micro_batch_sizes": [1, 2, 4], "min_gpus": 1, "max_gpus": 16,
+    "min_time": 0, "version": 0.2, "prefer_larger_batch": True,
+    "model_parallel_size": 1, "num_gpus_per_node": 1}}
+
+
+def _script(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(body)
+    return [sys.executable, str(p)]
+
+
+class TestElasticAgent:
+    def test_clean_exit(self, tmp_path):
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, "print('ok')\n")),
+                               monitor_interval=0.1)
+        assert agent.run() == 0
+        assert agent.restart_count == 0
+
+    def test_restart_on_failure_then_success(self, tmp_path):
+        marker = tmp_path / "attempt"
+        body = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+            "open(m, 'w').write(str(n + 1))\n"
+            "sys.exit(0 if n >= 2 else 7)\n")
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, body)),
+                               max_restarts=5, monitor_interval=0.1)
+        assert agent.run() == 0
+        assert agent.restart_count == 2
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, "import sys; sys.exit(3)\n")),
+            max_restarts=2, monitor_interval=0.1)
+        assert agent.run() == 3
+        assert agent.restart_count == 2
+
+    def test_membership_change_restarts_with_new_batch(self, tmp_path):
+        log = tmp_path / "worlds.log"
+        body = (
+            "import os, time\n"
+            f"open({str(log)!r}, 'a').write(\n"
+            "    os.environ['DS_ELASTIC_WORLD_SIZE'] + ':' +\n"
+            "    os.environ['DS_ELASTIC_TRAIN_BATCH'] + '\\n')\n"
+            "time.sleep(30)\n")
+        worlds = iter([2, 2, 2, 4])     # world flips to 4 on the 4th probe
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, body)), ds_config=ELASTIC_CFG,
+            monitor_interval=1.0,
+            world_size_fn=lambda: next(worlds, 4))
+        agent.run(max_steps=8)
+        for _ in range(20):              # allow slow interpreter startup
+            if log.exists() and len(log.read_text().splitlines()) >= 2:
+                break
+            time.sleep(0.25)
+        lines = log.read_text().strip().splitlines()
+        assert len(lines) >= 2
+        w0, b0 = map(int, lines[0].split(":"))
+        w1, b1 = map(int, lines[-1].split(":"))
+        assert (w0, w1) == (2, 4)
+        assert b0 % 2 == 0 and b1 % 4 == 0      # solver fit each world size
+
+    def test_env_propagation(self, tmp_path):
+        out = tmp_path / "env.out"
+        body = f"import os; open({str(out)!r}, 'w').write(os.environ['MY_FLAG'])\n"
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, body), env={"MY_FLAG": "42"}),
+            monitor_interval=0.1)
+        agent.run()
+        assert out.read_text() == "42"
