@@ -56,7 +56,11 @@ def initialize(args=None,
         log_dist(f"autotuning: using experiment config {_at_cfg}", ranks=[0])
     # elastic agent restart: the re-solved batch config arrives in env
     # (elasticity/elastic_agent.py writes it before each worker start)
-    if os.environ.get("DS_ELASTIC_TRAIN_BATCH") and isinstance(config, dict):
+    if os.environ.get("DS_ELASTIC_TRAIN_BATCH") and config is not None:
+        if isinstance(config, str) and os.path.isfile(config):
+            import json as _json
+            with open(config) as _f:
+                config = _json.load(_f)
         config = dict(config)
         config["train_batch_size"] = int(os.environ["DS_ELASTIC_TRAIN_BATCH"])
         config["train_micro_batch_size_per_gpu"] = int(

@@ -25,11 +25,23 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 class WorkerSpec:
-    """What to run on each (re)start: argv template + env."""
+    """What to run on each (re)start: argv template + env.
 
-    def __init__(self, cmd: List[str], env: Optional[Dict[str, str]] = None):
-        self.cmd = list(cmd)
+    ``cmd`` may be a list (fixed argv) or a callable returning the argv —
+    callables receive the restart's full env dict (so launchers that bake
+    env exports into the command, pdsh/mpirun, pick up the re-solved batch
+    config and the live host set) and are invoked per (re)start."""
+
+    def __init__(self, cmd, env: Optional[Dict[str, str]] = None):
+        self.cmd = cmd
         self.env = dict(env or {})
+
+    def argv(self, env: Optional[Dict[str, str]] = None) -> List[str]:
+        if callable(self.cmd):
+            import inspect
+            params = inspect.signature(self.cmd).parameters
+            return list(self.cmd(env or {}) if params else self.cmd())
+        return list(self.cmd)
 
 
 class DSElasticAgent:
@@ -66,8 +78,8 @@ class DSElasticAgent:
 
     def _start(self, world: int):
         self._world = world
-        self._proc = subprocess.Popen(self.spec.cmd,
-                                      env=self._elastic_env(world),
+        env = self._elastic_env(world)
+        self._proc = subprocess.Popen(self.spec.argv(env), env=env,
                                       start_new_session=True)
         log_dist(f"elastic agent: started workers (pid {self._proc.pid}, "
                  f"world {world})", ranks=[0])

@@ -155,11 +155,14 @@ def encode_world_info(resources: "OrderedDict[str, int]") -> str:
         json.dumps(dict(resources)).encode()).decode()
 
 
-def collect_env_exports(cwd: str = ".") -> Dict[str, str]:
+def collect_env_exports(cwd: str = ".",
+                        env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     """Env vars to propagate to remote nodes: the EXPORT_ENVS prefixes plus
-    anything listed in a ``.deepspeed_env`` file (reference ``runner.py:36``)."""
+    anything listed in a ``.deepspeed_env`` file (reference ``runner.py:36``).
+    ``env`` overrides the process environment (elastic restarts pass the
+    per-start env so the exports match it)."""
     exports = {}
-    for key, val in os.environ.items():
+    for key, val in (env if env is not None else os.environ).items():
         if any(key.startswith(p) for p in EXPORT_ENVS):
             exports[key] = val
     env_file = os.path.join(cwd, DEEPSPEED_ENVIRONMENT_NAME)
@@ -266,9 +269,22 @@ def main(args=None):
                                                             WorkerSpec)
         cfg_path = _find_user_config(args.user_args)
         ds_cfg = json.load(open(cfg_path)) if cfg_path else {}
-        agent = DSElasticAgent(WorkerSpec(cmd), ds_config=ds_cfg,
-                               max_restarts=args.max_elastic_restarts,
-                               world_size_fn=lambda: sum(resources.values()))
+
+        def current_resources():
+            res = fetch_hostfile(args.hostfile) or discover_tpu_pod()                 or OrderedDict({"localhost": 1})
+            return parse_inclusion_exclusion(res, args.include, args.exclude)
+
+        def build_cmd(env):
+            # re-read the hostfile and re-collect env (incl. DS_ELASTIC_*)
+            # so each restart targets the live membership
+            res = current_resources()
+            return runner_cls(args, res).get_cmd(
+                collect_env_exports(env=env), res)
+
+        agent = DSElasticAgent(
+            WorkerSpec(build_cmd), ds_config=ds_cfg,
+            max_restarts=args.max_elastic_restarts,
+            world_size_fn=lambda: sum(current_resources().values()))
         return agent.run()
     result = subprocess.run(cmd)
     return result.returncode

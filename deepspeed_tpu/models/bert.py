@@ -202,7 +202,9 @@ def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
 
     body = partial(bert_block, cfg, attention_fn=attention_fn, train=train)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+            checkpoint_policy)
+        body = jax.checkpoint(body, policy=checkpoint_policy())
     if cfg.scan_layers:
         L = cfg.num_hidden_layers
         rngs = (jax.random.split(jax.random.fold_in(rng, 7), L) if use_rngs

@@ -383,7 +383,9 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
 
     body = partial(gpt_block, cfg, train=train, attention_fn=attention_fn)
     if cfg.remat:
-        body = jax.checkpoint(body, static_argnums=())
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+            checkpoint_policy)
+        body = jax.checkpoint(body, policy=checkpoint_policy())
 
     # random-LTD: each block trains on its own sorted random token subset,
     # the rest riding the residual stream (data_pipeline/data_routing)

@@ -360,10 +360,11 @@ class HFLlamaPolicy(InjectionPolicy):
         blocks = []
         for i in range(cfg.n_layer):
             b = f"{pre}layers.{i}."
+            # HF llama checkpoints already use the half-split (x1|x2) rope
+            # pairing apply_rope implements — weights copy straight through
             qkv_w = np.concatenate(
-                [_rope_permute(sd[b + f"self_attn.{n}_proj.weight"],
-                               cfg.n_head).T for n in ("q", "k")]
-                + [sd[b + "self_attn.v_proj.weight"].T], axis=1)
+                [sd[b + f"self_attn.{n}_proj.weight"].T
+                 for n in ("q", "k", "v")], axis=1)
             blocks.append({
                 "ln1_g": sd[b + "input_layernorm.weight"],
                 "ln1_b": np.zeros((E,), np.float32),
@@ -389,16 +390,6 @@ class HFLlamaPolicy(InjectionPolicy):
             head if head is not None else sd[pre + "embed_tokens.weight"],
             cfg.padded_vocab)
         return cfg, params
-
-
-def _rope_permute(w: np.ndarray, n_head: int) -> np.ndarray:
-    """HF llama stores rope dims interleaved-halved per head relative to
-    the classic (x1|x2) pairing this repo's apply_rope uses: permute
-    [out, in] rows head-wise from (0,2,4,...,1,3,5...) HF layout back."""
-    out, inp = w.shape
-    D = out // n_head
-    w = w.reshape(n_head, 2, D // 2, inp)
-    return w.transpose(0, 2, 1, 3).reshape(out, inp)
 
 
 def _with(cfg, **kw):

@@ -60,17 +60,17 @@ def flash_attention(q, k, v, *, causal: bool = True, bias=None):
 
 
 def ring_attention(q, k, v, *, causal: bool = True, bias=None):
-    assert bias is None, "ring attention does not support logit bias yet"
     """Ring attention over the ``seq`` mesh axis (KV blocks rotated by
     ppermute); see ``deepspeed_tpu/parallel/sequence.py``."""
+    assert bias is None, "ring attention does not support logit bias yet"
     from deepspeed_tpu.parallel.sequence import ring_attention as ra
     return ra(q, k, v, causal=causal)
 
 
 def ulysses_attention(q, k, v, *, causal: bool = True, bias=None):
-    assert bias is None, "ulysses attention does not support logit bias yet"
     """Ulysses-style all-to-all sequence parallel attention; see
     ``deepspeed_tpu/parallel/sequence.py``."""
+    assert bias is None, "ulysses attention does not support logit bias yet"
     from deepspeed_tpu.parallel.sequence import ulysses_attention as ua
     return ua(q, k, v, causal=causal, inner=flash_attention)
 

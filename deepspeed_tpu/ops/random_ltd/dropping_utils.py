@@ -1,0 +1,65 @@
+"""Random-LTD token dropping utilities.
+
+Reference: ``deepspeed/ops/random_ltd/dropping_utils.py`` (wrappers over
+the ``csrc/random_ltd`` token_sort / gather_scatter kernels:
+``gpt_sample_tokens``/``bert_sample_tokens`` + GatherTokens /
+ScatterTokens).  On TPU these are jnp sort/take/scatter — XLA lowers them
+natively (SURVEY §2.3) — layered over
+``runtime/data_pipeline/data_routing/basic_layer.py``.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+    gather_tokens as _gather, sample_token_indices, scatter_tokens as _scatter)
+
+
+def gpt_sample_tokens(reserved_length: int, seq_length: int, batch_size: int,
+                      layers: int = 1, rng: Optional[jax.Array] = None,
+                      attn_mask: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """[layers, reserved] sorted sample indices (+ sliced causal mask).
+
+    GPT attention masks are positional, so the sliced mask for sorted
+    indices is just the causal mask over the subsequence (returned None —
+    kernels apply causality positionally)."""
+    rng = rng if rng is not None else jax.random.key(0)
+    idx = sample_token_indices(rng, seq_length, reserved_length, layers)
+    return idx, None
+
+
+def bert_sample_tokens(reserved_length: int, seq_length: int, batch_size: int,
+                       layers: int = 1, rng: Optional[jax.Array] = None,
+                       attn_mask: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Like :func:`gpt_sample_tokens` but also slices a [B, S] key-padding
+    mask down to the sampled positions per layer → [layers, B, reserved]."""
+    rng = rng if rng is not None else jax.random.key(1)
+    idx = sample_token_indices(rng, seq_length, reserved_length, layers)
+    if attn_mask is None:
+        return idx, None
+    sliced = jax.vmap(lambda i: jnp.take(attn_mask, i, axis=1))(idx)
+    return idx, sliced
+
+
+class GatherTokens:
+    """Reference autograd-function surface; functionally just a gather."""
+
+    @staticmethod
+    def apply(activations, sorted_indices, batch_first: bool = True):
+        x = activations if batch_first else activations.swapaxes(0, 1)
+        out = _gather(x, sorted_indices)
+        return (activations, out if batch_first else out.swapaxes(0, 1))
+
+
+class ScatterTokens:
+    @staticmethod
+    def apply(all_activations, layer_activations, sorted_indices,
+              batch_first: bool = True):
+        x = all_activations if batch_first else all_activations.swapaxes(0, 1)
+        sub = layer_activations if batch_first else layer_activations.swapaxes(0, 1)
+        out = _scatter(x, sub, sorted_indices)
+        return out if batch_first else out.swapaxes(0, 1)

@@ -1,0 +1,25 @@
+"""Spatial (diffusers/UNet) fused bias ops.
+
+Reference: ``csrc/spatial/csrc/opt_bias_add.cu`` (bias_add /
+bias_add_add / bias_add_bias_add for NHWC activations).  XLA fuses these
+elementwise chains into one kernel on TPU; the functions exist so
+reference-shaped code keeps its call sites (SURVEY §2.3 maps this row to
+"XLA fusion").
+"""
+
+import jax.numpy as jnp
+
+
+def nhwc_bias_add(activation, bias):
+    """out = a + bias (bias broadcast over N, H, W)."""
+    return activation + bias.reshape((1,) * (activation.ndim - 1) + (-1,))
+
+
+def nhwc_bias_add_add(activation, bias, other):
+    """out = (a + bias) + other."""
+    return nhwc_bias_add(activation, bias) + other
+
+
+def nhwc_bias_add_bias_add(activation, bias, other, other_bias):
+    """out = (a + bias) + (other + other_bias)."""
+    return nhwc_bias_add(activation, bias) + nhwc_bias_add(other, other_bias)

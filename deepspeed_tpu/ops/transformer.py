@@ -1,0 +1,121 @@
+"""Fused transformer layer — reference API surface.
+
+Reference: ``deepspeed/ops/transformer/transformer.py`` (``
+DeepSpeedTransformerConfig:34`` + ``DeepSpeedTransformerLayer:296``, the
+Python face of the ~6.5k-line CUDA training kernel) and the
+``stochastic_transformer`` builder variant (``op_builder/
+stochastic_transformer.py:22``).
+
+TPU-native: the fused layer IS ``models/bert.bert_block`` under jit —
+LN/QKV/attention/GELU/dropout fuse in XLA with the flash-attention Pallas
+kernel as the hot op.  This module provides the reference's config+layer
+class surface on top of it.  ``stochastic_mode`` (the reference's
+speed-for-reproducibility trade) is accepted and is a documented no-op:
+TPU/XLA execution is deterministic at full speed, so there is nothing to
+trade.
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.bert import BertConfig, bert_block, _init_block
+
+
+class DeepSpeedTransformerConfig:
+    """Reference ``DeepSpeedTransformerConfig:34`` fields."""
+
+    def __init__(self, batch_size: int = -1, hidden_size: int = -1,
+                 intermediate_size: int = -1, heads: int = -1,
+                 attn_dropout_ratio: float = 0.0,
+                 hidden_dropout_ratio: float = 0.0,
+                 num_hidden_layers: int = -1, initializer_range: float = 0.02,
+                 layer_norm_eps: float = 1e-12, local_rank: int = -1,
+                 seed: int = -1, fp16: bool = False, pre_layer_norm: bool = True,
+                 normalize_invertible: bool = False, gelu_checkpoint: bool = False,
+                 adjust_init_range: bool = True, attn_dropout_checkpoint: bool = False,
+                 stochastic_mode: bool = False, return_tuple: bool = False,
+                 training: bool = True):
+        self.batch_size = batch_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = (intermediate_size if intermediate_size > 0
+                                  else 4 * hidden_size)
+        self.heads = heads
+        self.attn_dropout_ratio = attn_dropout_ratio
+        self.hidden_dropout_ratio = hidden_dropout_ratio
+        self.num_hidden_layers = num_hidden_layers
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.pre_layer_norm = pre_layer_norm
+        self.fp16 = fp16
+        self.stochastic_mode = stochastic_mode   # no-op: TPU is deterministic
+        self.training = training
+        self.return_tuple = return_tuple
+
+    @classmethod
+    def from_dict(cls, json_object: Dict) -> "DeepSpeedTransformerConfig":
+        cfg = cls()
+        for k, v in json_object.items():
+            setattr(cfg, k, v)
+        return cfg
+
+
+class DeepSpeedTransformerLayer:
+    """Reference ``DeepSpeedTransformerLayer:296``: one fused encoder
+    layer with its own parameters; jit-compiled on first call."""
+
+    _layer_id = 0
+
+    def __init__(self, config: DeepSpeedTransformerConfig,
+                 initial_weights=None, initial_biases=None, seed: int = 0):
+        self.config = config
+        self.layer_id = DeepSpeedTransformerLayer._layer_id
+        DeepSpeedTransformerLayer._layer_id += 1
+        self._bcfg = BertConfig(
+            vocab_size=128,  # unused by the block
+            hidden_size=config.hidden_size,
+            num_hidden_layers=max(config.num_hidden_layers, 1),
+            num_attention_heads=config.heads,
+            intermediate_size=config.intermediate_size,
+            hidden_dropout_prob=config.hidden_dropout_ratio,
+            pre_ln=config.pre_layer_norm,
+            dtype=jnp.float16 if config.fp16 else jnp.float32,
+            ln_eps=config.layer_norm_eps)
+        self.params = _init_block(self._bcfg,
+                                  jax.random.key(seed + self.layer_id))
+        if initial_weights is not None or initial_biases is not None:
+            self.load_weights(initial_weights, initial_biases)
+        self._fn = None
+
+    def load_weights(self, weights, biases):
+        """Install externally-created [qkv, out, fc, proj] weight/bias
+        lists (the reference's initial_weights/initial_biases path)."""
+        names_w = ["qkv_w", "out_w", "fc_w", "proj_w"]
+        names_b = ["qkv_b", "out_b", "fc_b", "proj_b"]
+        for n, w in zip(names_w, weights or []):
+            self.params[n] = jnp.asarray(w)
+        for n, b in zip(names_b, biases or []):
+            self.params[n] = jnp.asarray(b)
+
+    def __call__(self, hidden_states, attention_mask=None, rng=None):
+        from deepspeed_tpu.ops.attention import get_attention_fn
+        if self._fn is None:
+            cfg = self._bcfg
+
+            def fn(p, x, r):
+                return bert_block(cfg, p, x, get_attention_fn("auto"),
+                                  rng=r, train=self.config.training)
+
+            self._fn = jax.jit(fn)
+        rng = rng if rng is not None else jax.random.key(0)
+        out = self._fn(self.params, hidden_states, rng)
+        return (out,) if self.config.return_tuple else out
+
+
+def stochastic_transformer_layer(config: DeepSpeedTransformerConfig,
+                                 **kwargs) -> DeepSpeedTransformerLayer:
+    """Reference ``op_builder/stochastic_transformer.py:22`` variant:
+    identical layer with ``stochastic_mode=True`` (documented no-op)."""
+    config.stochastic_mode = True
+    return DeepSpeedTransformerLayer(config, **kwargs)

@@ -1,0 +1,90 @@
+"""Activation checkpointing (rematerialization).
+
+Reference: ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(``CheckpointFunction:474``, ``checkpoint():708``, ``configure():789``,
+partition/cpu-offload helpers ``:255,366,421``).
+
+TPU mapping (SURVEY §5.7):
+- ``checkpoint(fn, *args)``      → ``jax.checkpoint`` with the configured
+  rematerialization policy (XLA re-runs the forward in the backward pass;
+  no RNG-state stashing needed — jax PRNG is functional).
+- ``partition_activations``      → subsumed by SPMD: saved activations
+  inherit the model's sharding constraints, so with a ``seq``/``tensor``
+  axis they are already partitioned across ranks; the flag selects the
+  dots-saveable policy so what *is* saved is the sharded matmul outputs.
+- ``cpu_checkpointing``          → offload policy: saved dot products are
+  kept in pinned host memory (``offload_dot_with_no_batch_dims``).
+- ``contiguous_memory_optimization`` → XLA owns the arena; accepted as a
+  no-op (there is no fragmentation to manage by hand).
+- ``number_checkpoints``/``profile`` → recorded and surfaced via
+  ``get_config``; segment counts are a model-side choice in functional
+  code (e.g. scan-over-layers checkpoints once per layer).
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+_config: Dict[str, Any] = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "number_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+}
+
+
+def configure(mpu_=None, deepspeed_config: Optional[Dict] = None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None,
+              num_checkpoints: Optional[int] = None):
+    """Reference ``configure():789`` surface: flags from kwargs or the
+    ``activation_checkpointing`` config block."""
+    block = {}
+    if deepspeed_config:
+        block = (deepspeed_config.get("activation_checkpointing", {})
+                 if isinstance(deepspeed_config, dict) else {})
+    for key, arg in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize_checkpoint_boundary", synchronize),
+                     ("profile", profile),
+                     ("number_checkpoints", num_checkpoints)):
+        if arg is not None:
+            _config[key] = arg
+        elif key in block:
+            _config[key] = block[key]
+    log_dist(f"activation checkpointing configured: {_config}", ranks=[0])
+
+
+def get_config() -> Dict[str, Any]:
+    return dict(_config)
+
+
+def checkpoint_policy():
+    """The jax.checkpoint policy the current config selects."""
+    if _config["cpu_checkpointing"]:
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    if _config["partition_activations"]:
+        # keep the (sharded) matmul outputs, recompute elementwise work
+        return jax.checkpoint_policies.dots_saveable
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def checkpoint(function: Callable, *args):
+    """Reference ``checkpoint():708``: run ``function`` under remat with
+    the configured policy."""
+    return jax.checkpoint(function, policy=checkpoint_policy())(*args)
+
+
+def is_configured() -> bool:
+    return any(_config[k] for k in ("partition_activations",
+                                    "cpu_checkpointing",
+                                    "contiguous_memory_optimization"))

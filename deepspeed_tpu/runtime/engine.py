@@ -242,6 +242,24 @@ class DeepSpeedEngine:
             self._compression_enabled = (
                 self.compression_scheduler.check_all_modules(0))
 
+        # activation checkpointing: the config block selects the remat
+        # policy (runtime/activation_checkpointing/checkpointing.py) and
+        # flips the model's remat flag when it exposes one
+        ac = self._config.activation_checkpointing_config
+        if (ac.partition_activations or ac.cpu_checkpointing
+                or ac.contiguous_memory_optimization or ac.number_checkpoints):
+            from deepspeed_tpu.runtime.activation_checkpointing import (
+                checkpointing as act_ckpt)
+            act_ckpt.configure(deepspeed_config={
+                "activation_checkpointing": ac.model_dump()
+                if hasattr(ac, "model_dump") else vars(ac)})
+            mcfg = getattr(self.module, "cfg", None)
+            if mcfg is not None and hasattr(mcfg, "remat") and not mcfg.remat:
+                import dataclasses as _dc
+                self.module.cfg = _dc.replace(mcfg, remat=True)
+                log_dist("activation checkpointing: model remat enabled",
+                         ranks=[0])
+
         # MoQ quantize-on-train (reference runtime/quantize.py) + block
         # eigenvalues (runtime/eigenvalue.py) for curvature-aware periods
         self.quantizer = None

@@ -23,9 +23,16 @@ schedule is ONE XLA program:
   ``activation_checkpoint_interval`` applies ``jax.checkpoint`` to the
   stage body, the standard TPU trade (recompute in the backward pipeline).
 
-Known redundancy (documented trade): every stage computes the (cheap)
-embedding and the head/loss each tick — keeping the program SPMD.  The
-waste is ``head_flops / stage_flops`` per tick, small for real configs.
+Known redundancy (documented trade): the embed/head programs are part of
+every tick to keep the schedule SPMD, but fill/drain ticks skip their
+FLOPs through ``lax.cond`` (TPU executes one branch): the head + loss run
+only on the M ticks that complete a micro-batch and the embedding only on
+the M ticks that start one.  The remaining cost is the head being
+replicated over the ``pipe`` axis groups during steady state — the price
+of the single-program design vs the reference's per-stage processes
+(heterogeneous per-stage programs are the planned lift; until then
+``PipelineModule.partition()`` describes layouts the vmap engine does not
+consume).
 
 Layer contract (functional analogue of the reference's layer list): each
 ``LayerSpec`` builds an object with ``init_params(rng)`` and
@@ -179,21 +186,36 @@ class _PipelinedModel:
             y, loss_sum = carry                      # y: [P, B, S, E]
             tm = jnp.clip(t, 0, M - 1)
             r_t = jax.random.fold_in(rng, t)
-            ekw = ({"rng": r_t, "train": train_rng} if embed_takes_rng else {})
-            x0 = self.embed(params["embed"], jax.tree.map(lambda a: a[tm], inputs),
-                            **ekw)
+
+            # embed only feeds real micro-batches: drain ticks (t >= M)
+            # skip its FLOPs via cond (TPU executes one branch)
+            def do_embed(_):
+                ekw = ({"rng": r_t, "train": train_rng} if embed_takes_rng else {})
+                x0 = self.embed(params["embed"],
+                                jax.tree.map(lambda a: a[tm], inputs), **ekw)
+                return x0.astype(y.dtype)
+
+            x0 = jax.lax.cond(t < M, do_embed,
+                              lambda _: jnp.zeros(y.shape[1:], y.dtype), 0)
             y = jnp.roll(y, 1, axis=0)               # stage i <- stage i-1
-            y = y.at[0].set(x0.astype(y.dtype))
+            y = y.at[0].set(x0)
             y = self._stage_constrain(y)
             stage_rngs = jax.vmap(lambda i: jax.random.fold_in(r_t, i))(jnp.arange(P))
             y = jax.vmap(body)(blocks, y, stage_rngs)
             y = self._stage_constrain(y)
             m = t - (P - 1)
             mv = jnp.clip(m, 0, M - 1)
-            out = self._call_head(params["head"], y[-1], params["embed"],
-                                  jax.random.fold_in(r_t, P), train_rng)
-            l = self.loss_fn(out, jax.tree.map(lambda a: a[mv], labels))
-            loss_sum = loss_sum + jnp.where(m >= 0, l, 0.0)
+
+            # the vocab head + loss only see completed micro-batches: fill
+            # ticks (m < 0) skip the S·E·V head matmul entirely
+            def do_head(y_last):
+                out = self._call_head(params["head"], y_last, params["embed"],
+                                      jax.random.fold_in(r_t, P), train_rng)
+                return self.loss_fn(out, jax.tree.map(lambda a: a[mv], labels))
+
+            l = jax.lax.cond(m >= 0, do_head, lambda _: jnp.zeros((), jnp.float32),
+                             y[-1])
+            loss_sum = loss_sum + l
             return (y, loss_sum), None
 
         ekw0 = ({"rng": rng, "train": False} if embed_takes_rng else {})

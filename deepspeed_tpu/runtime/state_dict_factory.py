@@ -57,7 +57,8 @@ def _load_one(path: str) -> Dict[str, np.ndarray]:
 DEFAULT_AXIS_RULES = (
     # (substring pattern, split axis kind) — FIRST match wins, so the more
     # specific row-parallel names precede the broad column patterns
-    ("fc2", "row"), ("out_w", "row"), ("o_proj", "row"), ("c_proj", "row"),
+    ("fc2", "row"), ("out_w", "row"), ("proj_w", "row"),
+    ("o_proj", "row"), ("c_proj", "row"),
     ("down_proj", "row"), ("dense_4h_to_h", "row"),
     ("qkv", "column"), ("query_key_value", "column"),
     ("c_attn", "column"), ("fc", "column"), ("c_fc", "column"),

@@ -1,0 +1,120 @@
+"""Small-op parity: random-LTD dropping utils, spatial bias ops, the fused
+transformer layer surface, activation-checkpointing policy mapping."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+class TestDroppingUtils:
+    def test_gpt_sample_and_gather_scatter(self):
+        from deepspeed_tpu.ops.random_ltd.dropping_utils import (
+            GatherTokens, ScatterTokens, gpt_sample_tokens)
+        idx, mask = gpt_sample_tokens(8, 32, batch_size=2, layers=3,
+                                      rng=jax.random.key(0))
+        assert idx.shape == (3, 8) and mask is None
+        x = jnp.arange(2 * 32 * 4, dtype=jnp.float32).reshape(2, 32, 4)
+        full, sub = GatherTokens.apply(x, idx[0])
+        assert sub.shape == (2, 8, 4)
+        back = ScatterTokens.apply(x, sub + 1.0, idx[0])
+        np.testing.assert_allclose(np.asarray(back)[:, np.asarray(idx[0])],
+                                   np.asarray(sub) + 1.0)
+
+    def test_bert_sample_slices_mask(self):
+        from deepspeed_tpu.ops.random_ltd.dropping_utils import bert_sample_tokens
+        mask = jnp.ones((2, 32))
+        idx, sliced = bert_sample_tokens(8, 32, 2, layers=2,
+                                         rng=jax.random.key(1), attn_mask=mask)
+        assert sliced.shape == (2, 2, 8)
+
+
+class TestSpatialOps:
+    def test_bias_add_variants(self):
+        from deepspeed_tpu.ops.spatial import (nhwc_bias_add,
+                                               nhwc_bias_add_add,
+                                               nhwc_bias_add_bias_add)
+        a = jnp.ones((2, 4, 4, 8))
+        b = jnp.arange(8, dtype=jnp.float32)
+        o = nhwc_bias_add(a, b)
+        np.testing.assert_allclose(np.asarray(o)[0, 0, 0], 1 + np.arange(8))
+        o2 = nhwc_bias_add_add(a, b, a)
+        np.testing.assert_allclose(np.asarray(o2)[0, 0, 0], 2 + np.arange(8))
+        o3 = nhwc_bias_add_bias_add(a, b, a, b)
+        np.testing.assert_allclose(np.asarray(o3)[0, 0, 0], 2 + 2 * np.arange(8))
+
+
+class TestTransformerLayer:
+    def test_layer_runs_and_stochastic_variant(self):
+        from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                                   DeepSpeedTransformerLayer,
+                                                   stochastic_transformer_layer)
+        cfg = DeepSpeedTransformerConfig(batch_size=2, hidden_size=32,
+                                         heads=4, num_hidden_layers=2,
+                                         training=False, return_tuple=True)
+        layer = DeepSpeedTransformerLayer(cfg)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 32)),
+                        jnp.float32)
+        (out,) = layer(x)
+        assert out.shape == x.shape
+        st = stochastic_transformer_layer(
+            DeepSpeedTransformerConfig(hidden_size=32, heads=4,
+                                       num_hidden_layers=2, training=False))
+        assert st.config.stochastic_mode
+        assert st(x).shape == x.shape
+
+    def test_load_weights(self):
+        from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                                   DeepSpeedTransformerLayer)
+        cfg = DeepSpeedTransformerConfig(hidden_size=16, heads=2,
+                                         num_hidden_layers=1, training=False)
+        layer = DeepSpeedTransformerLayer(cfg)
+        qkv = np.zeros((16, 48), np.float32)
+        layer.load_weights([qkv], [np.zeros(48, np.float32)])
+        np.testing.assert_array_equal(layer.params["qkv_w"], qkv)
+
+
+class TestActivationCheckpointing:
+    def test_configure_and_policy_mapping(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as C
+        C.configure(deepspeed_config={"activation_checkpointing": {
+            "partition_activations": True}})
+        assert C.is_configured()
+        assert C.checkpoint_policy() is jax.checkpoint_policies.dots_saveable
+        C.configure(checkpoint_in_cpu=True)
+        # offload policy is a callable instance, not a named singleton
+        assert C.checkpoint_policy() is not jax.checkpoint_policies.dots_saveable
+        C.configure(partition_activations=False, checkpoint_in_cpu=False)
+        assert C.checkpoint_policy() is jax.checkpoint_policies.nothing_saveable
+
+    def test_checkpoint_fn_gradients(self):
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+            checkpoint)
+
+        def f(x):
+            return jnp.sum(jnp.tanh(x @ x))
+
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((8, 8)),
+                        jnp.float32)
+        g1 = jax.grad(lambda x: checkpoint(f, x))(x)
+        g2 = jax.grad(f)(x)
+        np.testing.assert_allclose(g1, g2, rtol=1e-5)
+
+    def test_engine_enables_model_remat(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt import GPT, GPTConfig
+        cfg = GPTConfig(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                        n_head=4, dtype=jnp.float32, attn_impl="reference")
+        model = GPT(cfg)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.key(0)),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "activation_checkpointing": {"partition_activations": True}})
+        assert engine.module.cfg.remat is True
+        ids = np.random.default_rng(0).integers(0, 128, (8, 64)).astype(np.int32)
+        loss = engine.forward(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        assert np.isfinite(float(loss))
