@@ -57,7 +57,11 @@ def initialize(args=None,
     # elastic agent restart: the re-solved batch config arrives in env
     # (elasticity/elastic_agent.py writes it before each worker start)
     if os.environ.get("DS_ELASTIC_TRAIN_BATCH") and config is not None:
-        if isinstance(config, str) and os.path.isfile(config):
+        if isinstance(config, (str, os.PathLike)):
+            if not os.path.isfile(config):
+                raise FileNotFoundError(
+                    f"elastic restart: config file {config!r} not found "
+                    f"(agent working directory differs from the launch?)")
             import json as _json
             with open(config) as _f:
                 config = _json.load(_f)

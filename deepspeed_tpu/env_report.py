@@ -56,9 +56,31 @@ def op_compatibility():
     def ring():
         from deepspeed_tpu.parallel.sequence import ring_attention  # noqa: F401
 
+    def sparse_attn():
+        import numpy as np
+
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+            block_sparse_attention)
+        x = jnp.zeros((1, 128, 1, 64), jnp.bfloat16)
+        layout = np.ones((1, 2, 2), np.int32)
+        jax.block_until_ready(block_sparse_attention(x, x, x, layout))
+
+    def async_io():
+        from deepspeed_tpu.ops.aio import AsyncIOBuilder
+        b = AsyncIOBuilder()
+        assert b.is_compatible(), "g++ or csrc/aio missing"
+        b.load()
+
+    def quantizer():
+        from deepspeed_tpu.ops.quantizer import quantize_dequantize
+        jax.block_until_ready(quantize_dequantize(jnp.ones((128,)), bits=8))
+
     probes = [("pallas_flash_attention", flash),
+              ("pallas_block_sparse_attention", sparse_attn),
               ("fused_optimizer", fused_adam),
-              ("ring_attention", ring)]
+              ("ring_attention", ring),
+              ("async_io (native)", async_io),
+              ("quantizer", quantizer)]
     out = []
     for name, fn in probes:
         ok, note = _probe_pallas_op(fn)

@@ -204,6 +204,22 @@ def _find_user_config(user_args):
     return None
 
 
+def resolve_resources(args) -> "OrderedDict[str, int]":
+    """hostfile/pod discovery + include/exclude + --num_nodes, the single
+    source of truth for the target host set (initial launch AND elastic
+    restarts resolve through here)."""
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        resources = discover_tpu_pod()
+    if not resources:
+        resources = OrderedDict({"localhost": 1})
+    resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    assert resources, "no usable hosts after include/exclude filtering"
+    if args.num_nodes > 0:
+        resources = OrderedDict(list(resources.items())[:args.num_nodes])
+    return resources
+
+
 def main(args=None):
     args = parse_args(args)
 
@@ -232,15 +248,7 @@ def main(args=None):
         os.environ[CONFIG_PATH_ENV] = os.path.join(
             tuner.results_dir, "ds_config_optimal.json")
 
-    resources = fetch_hostfile(args.hostfile)
-    if not resources:
-        resources = discover_tpu_pod()
-    if not resources:
-        resources = OrderedDict({"localhost": 1})
-    resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
-    assert resources, "no usable hosts after include/exclude filtering"
-    if args.num_nodes > 0:
-        resources = OrderedDict(list(resources.items())[:args.num_nodes])
+    resources = resolve_resources(args)
 
     multi_node = args.force_multi or len(resources) > 1
     if not multi_node:
@@ -277,14 +285,14 @@ def main(args=None):
         def build_cmd(env):
             # re-read the hostfile and re-collect env (incl. DS_ELASTIC_*)
             # so each restart targets the live membership
-            res = current_resources()
+            res = resolve_resources(args)
             return runner_cls(args, res).get_cmd(
                 collect_env_exports(env=env), res)
 
         agent = DSElasticAgent(
             WorkerSpec(build_cmd), ds_config=ds_cfg,
             max_restarts=args.max_elastic_restarts,
-            world_size_fn=lambda: sum(current_resources().values()))
+            world_size_fn=lambda: sum(resolve_resources(args).values()))
         return agent.run()
     result = subprocess.run(cmd)
     return result.returncode

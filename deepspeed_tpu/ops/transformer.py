@@ -58,6 +58,8 @@ class DeepSpeedTransformerConfig:
         cfg = cls()
         for k, v in json_object.items():
             setattr(cfg, k, v)
+        if cfg.intermediate_size is None or cfg.intermediate_size <= 0:
+            cfg.intermediate_size = 4 * cfg.hidden_size   # re-derive default
         return cfg
 
 
@@ -99,17 +101,30 @@ class DeepSpeedTransformerLayer:
             self.params[n] = jnp.asarray(b)
 
     def __call__(self, hidden_states, attention_mask=None, rng=None):
+        """``attention_mask``: [B, S] keep-mask (1 = attend) or an additive
+        bias broadcastable to [B, 1, 1, S], as the reference layer takes."""
         from deepspeed_tpu.ops.attention import get_attention_fn
         if self._fn is None:
             cfg = self._bcfg
 
-            def fn(p, x, r):
-                return bert_block(cfg, p, x, get_attention_fn("auto"),
+            def fn(p, x, r, bias):
+                attn = get_attention_fn("auto")
+                if bias is not None:
+                    attn = (lambda q, k, v, *, causal=False, inner=attn:
+                            inner(q, k, v, causal=causal, bias=bias))
+                return bert_block(cfg, p, x, attn,
                                   rng=r, train=self.config.training)
 
-            self._fn = jax.jit(fn)
+            self._fn = jax.jit(fn, static_argnames=())
         rng = rng if rng is not None else jax.random.key(0)
-        out = self._fn(self.params, hidden_states, rng)
+        bias = None
+        if attention_mask is not None:
+            m = jnp.asarray(attention_mask, jnp.float32)
+            if m.ndim == 2:   # keep-mask → additive
+                bias = ((1.0 - m) * -1e30)[:, None, None, :]
+            else:
+                bias = m
+        out = self._fn(self.params, hidden_states, rng, bias)
         return (out,) if self.config.return_tuple else out
 
 

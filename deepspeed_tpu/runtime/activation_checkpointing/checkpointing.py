@@ -27,6 +27,11 @@ import jax
 
 from deepspeed_tpu.utils.logging import log_dist
 
+# Module-global by design: the reference's ``deepspeed.checkpointing`` is
+# likewise process-global configuration (``configure():789`` sets module
+# state every caller shares).  Multi-engine processes that need different
+# remat policies should configure between builds (the policy is read at
+# trace time).
 _config: Dict[str, Any] = {
     "partition_activations": False,
     "contiguous_memory_optimization": False,

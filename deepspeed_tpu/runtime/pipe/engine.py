@@ -211,7 +211,8 @@ class _PipelinedModel:
             def do_head(y_last):
                 out = self._call_head(params["head"], y_last, params["embed"],
                                       jax.random.fold_in(r_t, P), train_rng)
-                return self.loss_fn(out, jax.tree.map(lambda a: a[mv], labels))
+                l = self.loss_fn(out, jax.tree.map(lambda a: a[mv], labels))
+                return l.astype(jnp.float32)   # cond branches must agree
 
             l = jax.lax.cond(m >= 0, do_head, lambda _: jnp.zeros((), jnp.float32),
                              y[-1])

@@ -58,13 +58,13 @@ class TestElasticAgent:
             "    os.environ['DS_ELASTIC_WORLD_SIZE'] + ':' +\n"
             "    os.environ['DS_ELASTIC_TRAIN_BATCH'] + '\\n')\n"
             "time.sleep(30)\n")
-        worlds = iter([2, 2, 2, 4])     # world flips to 4 on the 4th probe
+        worlds = iter([2, 2, 2, 2, 4])   # world flips to 4 on the 5th probe
         agent = DSElasticAgent(
             WorkerSpec(_script(tmp_path, body)), ds_config=ELASTIC_CFG,
-            monitor_interval=1.0,
+            monitor_interval=2.0,        # generous: CI machines run loaded
             world_size_fn=lambda: next(worlds, 4))
         agent.run(max_steps=8)
-        for _ in range(20):              # allow slow interpreter startup
+        for _ in range(40):              # allow slow interpreter startup
             if log.exists() and len(log.read_text().splitlines()) >= 2:
                 break
             time.sleep(0.25)

@@ -102,6 +102,9 @@ class TestActivationCheckpointing:
         np.testing.assert_allclose(g1, g2, rtol=1e-5)
 
     def test_engine_enables_model_remat(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import (
+            checkpointing as AC)
+        before = AC.get_config()
         import deepspeed_tpu
         from deepspeed_tpu.models.gpt import GPT, GPTConfig
         cfg = GPTConfig(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
@@ -118,3 +121,28 @@ class TestActivationCheckpointing:
         engine.backward(loss)
         engine.step()
         assert np.isfinite(float(loss))
+        AC._config.update(before)      # global by design; don't leak
+
+
+class TestTransformerLayerMask:
+    def test_attention_mask_blocks_padded_keys(self):
+        from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                                   DeepSpeedTransformerLayer)
+        cfg = DeepSpeedTransformerConfig(hidden_size=32, heads=4,
+                                         num_hidden_layers=1, training=False)
+        layer = DeepSpeedTransformerLayer(cfg)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((2, 16, 32)), jnp.float32)
+        mask = np.ones((2, 16), np.float32)
+        mask[:, 12:] = 0                      # pad the tail
+        out1 = layer(x, attention_mask=jnp.asarray(mask))
+        x2 = x.at[:, 12:].set(99.0)           # perturb masked positions
+        out2 = layer(x2, attention_mask=jnp.asarray(mask))
+        # unmasked positions must be unaffected by masked-key content
+        np.testing.assert_allclose(np.asarray(out1)[:, :12],
+                                   np.asarray(out2)[:, :12], atol=1e-5)
+        # and with no mask they ARE affected
+        out3 = layer(x)
+        out4 = layer(x2)
+        assert not np.allclose(np.asarray(out3)[:, :12],
+                               np.asarray(out4)[:, :12], atol=1e-5)
