@@ -79,6 +79,9 @@ class GPTConfig:
     intermediate_size: Optional[int] = None   # default 4*n_embd
     use_bias: bool = True                # LLaMA-style blocks are bias-free
     rope_theta: float = 10000.0
+    # grouped-query attention: number of K/V heads (None = n_head = MHA;
+    # 1 = MQA).  The KV cache stores only n_kv_head heads — the GQA win.
+    n_kv_head: Optional[int] = None
     # pad vocab to a multiple (MXU-friendly, and divisible by tensor axis)
     vocab_multiple: int = 128
 
@@ -87,6 +90,10 @@ class GPTConfig:
             math.ceil(self.vocab_size / self.vocab_multiple) * self.vocab_multiple)
         assert self.n_embd % self.n_head == 0
         self.head_dim = self.n_embd // self.n_head
+        self.kv_heads = self.n_kv_head or self.n_head
+        assert self.n_head % self.kv_heads == 0, \
+            f"n_head {self.n_head} not divisible by n_kv_head {self.kv_heads}"
+        self.qkv_dim = (self.n_head + 2 * self.kv_heads) * self.head_dim
         self.ffn_dim = self.intermediate_size or 4 * self.n_embd
         assert self.position_encoding in ("learned", "rope", "alibi")
         assert self.norm in ("layernorm", "rmsnorm")
@@ -151,8 +158,8 @@ def _init_block(cfg: GPTConfig, rng: Array) -> Dict:
     return {
         "ln1_g": jnp.ones((E,), jnp.float32),
         "ln1_b": jnp.zeros((E,), jnp.float32),
-        "qkv_w": _dense_init(ks[0], E, (E, 3 * E)),
-        "qkv_b": jnp.zeros((3 * E,), jnp.float32),
+        "qkv_w": _dense_init(ks[0], E, (E, cfg.qkv_dim)),
+        "qkv_b": jnp.zeros((cfg.qkv_dim,), jnp.float32),
         "out_w": _dense_init(ks[1], E, (E, E), scale=proj_scale),
         "out_b": jnp.zeros((E,), jnp.float32),
         "ln2_g": jnp.ones((E,), jnp.float32),
@@ -280,6 +287,22 @@ def apply_rope(x: Array, positions: Array, theta: float = 10000.0) -> Array:
     return out.astype(x.dtype)
 
 
+def _split_qkv(cfg: "GPTConfig", qkv: Array):
+    """[B, S, qkv_dim] → q [B,S,H,D], k/v [B,S,Hkv,D] (GQA-aware)."""
+    B, S = qkv.shape[:2]
+    H, Hkv, D = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    q, k, v = jnp.split(qkv, [H * D, (H + Hkv) * D], axis=-1)
+    return (q.reshape(B, S, H, D), k.reshape(B, S, Hkv, D),
+            v.reshape(B, S, Hkv, D))
+
+
+def _expand_kv(cfg: "GPTConfig", k: Array) -> Array:
+    """Repeat KV heads up to n_head for the attention op."""
+    if cfg.kv_heads == cfg.n_head:
+        return k
+    return jnp.repeat(k, cfg.n_head // cfg.kv_heads, axis=2)
+
+
 def _mlp(cfg: "GPTConfig", p: Dict, h: Array, dt) -> Array:
     up = h @ p["fc_w"].astype(dt)
     if cfg.use_bias:
@@ -324,14 +347,13 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
         qkv = h @ p["qkv_w"].astype(dt)
         if cfg.use_bias:
             qkv = qkv + p["qkv_b"].astype(dt)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, S, H, D)
-        k = k.reshape(B, S, H, D)
-        v = v.reshape(B, S, H, D)
+        q, k, v = _split_qkv(cfg, qkv)
         if cfg.position_encoding == "rope":
             pos = jnp.arange(S)
             q = apply_rope(q, pos, cfg.rope_theta)
             k = apply_rope(k, pos, cfg.rope_theta)
+        k = _expand_kv(cfg, k)
+        v = _expand_kv(cfg, v)
         # heads sharded over tensor axis (Megatron attention parallelism)
         q = _constrain(q, mesh_lib.BATCH_AXES, "seq", "tensor", None)
         k = _constrain(k, mesh_lib.BATCH_AXES, "seq", "tensor", None)
@@ -464,9 +486,10 @@ def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
 # softmax_context kernel + inference_context.h workspace, SURVEY.md §2.3)
 # --------------------------------------------------------------------------- #
 def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int) -> Dict:
-    """Per-layer K/V cache, stacked [L, B, max_len, H, D] (scan-friendly).
-    Sharded: batch over DP axes, heads over tensor."""
-    L, H, D = cfg.n_layer, cfg.n_head, cfg.head_dim
+    """Per-layer K/V cache, stacked [L, B, max_len, Hkv, D] (scan-friendly;
+    GQA stores only the kv heads).  Sharded: batch over DP, heads over
+    tensor."""
+    L, H, D = cfg.n_layer, cfg.kv_heads, cfg.head_dim
     shape = (L, batch, max_len, H, D)
     k = jnp.zeros(shape, cfg.dtype)
     v = jnp.zeros(shape, cfg.dtype)
@@ -479,20 +502,28 @@ def _cached_attention(q, ck, cv, pos, bias=None):
     """q: [B, S_q, H, D] attends causally to cache positions <= its own
     global position (query i sits at ``pos + i``).  Static shapes:
     full-cache attention with masking — the standard TPU decode pattern.
+
+    GQA-aware: the cache may carry only ``Hkv`` heads; attention is
+    computed GROUPED against the un-expanded cache (no [B, T, H, D]
+    materialization — the bandwidth saving is the point of GQA).
     ``bias``: additive [1, H, S_q, T] logit bias (ALiBi)."""
     B, Sq, H, D = q.shape
-    T = ck.shape[1]
+    T, Hkv = ck.shape[1], ck.shape[2]
+    G = H // Hkv
     scale = 1.0 / np.sqrt(D)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   ck.astype(jnp.float32)) * scale
+    qg = q.reshape(B, Sq, Hkv, G, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale       # [B, Hkv, G, Sq, T]
     if bias is not None:
-        s = s + bias.astype(jnp.float32)
+        s = s + bias.astype(jnp.float32).reshape(
+            bias.shape[0], Hkv, G, *bias.shape[2:])
     kpos = jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 1)
     qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 0)
     mask = kpos <= qpos
-    s = jnp.where(mask[None, None], s, -1e30)
+    s = jnp.where(mask[None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cv)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(q.dtype), cv)
+    return out.reshape(B, Sq, H, D)
 
 
 def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
@@ -530,14 +561,13 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
         qkv = h @ p["qkv_w"].astype(dt)
         if cfg.use_bias:
             qkv = qkv + p["qkv_b"].astype(dt)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, S, H, D)
-        k = k.reshape(B, S, H, D)
-        v = v.reshape(B, S, H, D)
+        q, k, v = _split_qkv(cfg, qkv)
         if cfg.position_encoding == "rope":
             rpos = pos + jnp.arange(S)
             q = apply_rope(q, rpos, cfg.rope_theta)
             k = apply_rope(k, rpos, cfg.rope_theta)
+        # the cache stores only kv_heads heads (the GQA memory win);
+        # expansion to n_head happens at attention time
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=1)
         o = _cached_attention(q, ck, cv, pos, bias=attn_bias).reshape(B, S, E)
@@ -741,9 +771,19 @@ class GPT:
 
     def num_params(self) -> int:
         cfg = self.cfg
-        E, L = cfg.n_embd, cfg.n_layer
-        per_block = 12 * E * E + 13 * E
-        return cfg.padded_vocab * E + cfg.n_positions * E + L * per_block + 2 * E
+        E, L, I = cfg.n_embd, cfg.n_layer, cfg.ffn_dim
+        fc_out = 2 * I if cfg.mlp_type == "swiglu" else I
+        per_block = (E * cfg.qkv_dim + cfg.qkv_dim      # qkv (GQA-sized)
+                     + E * E + E                        # attn out
+                     + E * fc_out + fc_out              # mlp up (gate|up)
+                     + I * E + E                        # mlp down
+                     + 4 * E)                           # two norms
+        total = cfg.padded_vocab * E + L * per_block + 2 * E
+        if cfg.position_encoding == "learned":
+            total += cfg.n_positions * E
+        if cfg.untied_head:
+            total += cfg.padded_vocab * E
+        return total
 
     def flops_per_token(self, seq_len: int) -> float:
         """Training FLOPs/token ≈ 6N + attention term (PaLM appendix B)."""

@@ -342,8 +342,6 @@ class HFLlamaPolicy(InjectionPolicy):
 
     def build(self, hf_model):
         hc = hf_model.config
-        assert getattr(hc, "num_key_value_heads", hc.num_attention_heads) \
-            == hc.num_attention_heads, "GQA/MQA not supported by the fused block yet"
         from deepspeed_tpu.models.gpt import llama_config
         sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
         head = _untied_head(hc, sd, "lm_head.weight")
@@ -351,6 +349,8 @@ class HFLlamaPolicy(InjectionPolicy):
                            n_positions=hc.max_position_embeddings,
                            n_embd=hc.hidden_size, n_layer=hc.num_hidden_layers,
                            n_head=hc.num_attention_heads,
+                           n_kv_head=getattr(hc, "num_key_value_heads",
+                                             hc.num_attention_heads),
                            intermediate_size=hc.intermediate_size,
                            ln_eps=hc.rms_norm_eps,
                            rope_theta=getattr(hc, "rope_theta", 10000.0),
@@ -369,7 +369,7 @@ class HFLlamaPolicy(InjectionPolicy):
                 "ln1_g": sd[b + "input_layernorm.weight"],
                 "ln1_b": np.zeros((E,), np.float32),
                 "qkv_w": qkv_w,
-                "qkv_b": np.zeros((3 * E,), np.float32),
+                "qkv_b": np.zeros((cfg.qkv_dim,), np.float32),
                 "out_w": sd[b + "self_attn.o_proj.weight"].T,
                 "out_b": np.zeros((E,), np.float32),
                 "ln2_g": sd[b + "post_attention_layernorm.weight"],
