@@ -1,13 +1,22 @@
-"""Headline benchmark: GPT-2 training throughput on the available device(s).
+"""Benchmarks on the available device(s).  Prints ONE JSON line per run:
+{"metric", "value", "unit", "vs_baseline", ...}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Modes (BENCH_MODE):
 
-value        = model TFLOPs/chip sustained during training steps
-               (6N + attn FLOPs per token — PaLM appendix-B accounting).
-vs_baseline  = value / 64.0 — the reference's headline "64 TFLOPS/GPU
-               BERT-large on V100" (BASELINE.md; docs/_posts/
-               2020-05-28-fastest-bert-training.md:13).  Same accounting
-               style (achieved model FLOPs on one chip).
+* ``train`` (default, the headline): GPT-2 training throughput.
+  value       = model TFLOPs/chip sustained (6N + attn FLOPs per token —
+                PaLM appendix-B accounting).
+  vs_baseline = value / 64.0 — the reference's headline "64 TFLOPS/GPU
+                BERT-large on V100" (BASELINE.md; docs/_posts/
+                2020-05-28-fastest-bert-training.md:13).  Same accounting
+                style (achieved model FLOPs on one chip).
+* ``bert``: BERT-large MLM pretraining at seq 128 — the reference's actual
+  record workload (BASELINE rung 2, ZeRO-1 + fused Adam).  Same value /
+  vs_baseline semantics as ``train`` (directly comparable to the 64).
+* ``decode``: autoregressive decode tokens/sec on GPT-2 (BASELINE rung-5
+  stand-in).  Decode is weight-bandwidth-bound, so
+  vs_baseline = achieved HBM read rate / 819 GB/s (v5e HBM roofline):
+  each generated token must stream the full parameter bytes.
 
 Timing methodology: the driver may run this through a remote-tunneled TPU
 runtime where ``jax.block_until_ready`` returns before device execution
@@ -16,9 +25,12 @@ dispatch chains of different lengths, each ended by a single scalar fetch
 (the only true sync point), and the per-step cost is the difference — the
 fixed round-trip and dispatch overheads cancel.
 
-Env knobs: BENCH_MODEL (gpt2|gpt2-medium|gpt2-large|gpt2-xl, default gpt2),
-BENCH_SEQ (default 512), BENCH_MICRO (default 16), BENCH_STEPS (default 16),
-BENCH_REMAT (1 = activation checkpointing, default 0).
+Env knobs: BENCH_MODE (train|bert|decode), BENCH_MODEL (gpt2|gpt2-medium|
+gpt2-large|gpt2-xl | bert-base|bert-large), BENCH_SEQ (default 512 train /
+128 bert), BENCH_MICRO (default 16 train / 32 bert), BENCH_STEPS (default
+16), BENCH_REMAT (1 = activation checkpointing, default 0), BENCH_ATTN
+(auto|flash|reference, default auto), BENCH_DECODE_BATCH (default 8),
+BENCH_NEW_TOKENS (default 128).
 """
 
 import json
@@ -27,11 +39,45 @@ import time
 
 import numpy as np
 
+V5E_HBM_GBPS = 819.0
 
-def main():
+
+def _chain_timer(step_fn, fetch, base_n=3, steps=16):
+    """Time ``steps`` iterations by differencing two dispatch chains."""
+    def chain(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = step_fn()
+        val = fetch(out)
+        return time.perf_counter() - t0, val
+
+    d_short, _ = chain(base_n)
+    d_long, val = chain(base_n + steps)
+    return (d_long - d_short) / steps, val
+
+
+def _train_engine(model, micro, zero_stage):
+    import deepspeed_tpu
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": zero_stage},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10 ** 9,   # no host-syncing log fetches in the loop
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    # keep the throughput timer's device drains out of the timed chains —
+    # a single sync inside only one chain would skew the differencing
+    engine.tput_timer.start_step = 10 ** 12
+    return engine
+
+
+def bench_train():
     import jax
     import jax.numpy as jnp
-    import deepspeed_tpu
     from deepspeed_tpu.models.gpt import GPT, gpt_config
 
     n_dev = jax.device_count()
@@ -42,59 +88,130 @@ def main():
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
 
     cfg = gpt_config(preset, n_positions=seq, scan_layers=True,
-                     remat=remat, attn_impl="auto")
+                     remat=remat,
+                     attn_impl=os.environ.get("BENCH_ATTN", "auto"))
     model = GPT(cfg)
-
-    config = {
-        "train_micro_batch_size_per_gpu": micro,
-        "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
-        "bf16": {"enabled": True},
-        "gradient_clipping": 1.0,
-        "steps_per_print": 10 ** 9,   # no host-syncing log fetches in the loop
-    }
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
-    # keep the throughput timer's device drains out of the timed chains —
-    # a single sync inside only one chain would skew the differencing
-    engine.tput_timer.start_step = 10 ** 12
+    engine = _train_engine(model, micro, 1 if n_dev > 1 else 0)
 
     rng = np.random.default_rng(0)
     global_batch = micro * n_dev
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, global_batch, seq)), jnp.int32)
     batch = (ids, ids)
 
-    # warmup (compile) — the scalar fetch is the sync
+    for _ in range(2):   # warmup (compile); the scalar fetch is the sync
+        loss = engine.train_batch(batch=batch)
+    float(loss)
+
+    per_step, loss_val = _chain_timer(
+        lambda: engine.train_batch(batch=batch), lambda l: float(l), steps=steps)
+
+    samples_per_sec = global_batch / per_step
+    tflops = samples_per_sec * seq * model.flops_per_token(seq) / n_dev / 1e12
+    print(json.dumps({
+        "metric": f"{preset} train TFLOPs/chip (seq={seq}, micro={micro}, "
+                  f"{n_dev}x{jax.devices()[0].platform})",
+        "value": round(tflops, 3),
+        "unit": "TFLOPs/chip",
+        "vs_baseline": round(tflops / 64.0, 4),
+        "samples_per_sec": round(samples_per_sec, 2),
+        "loss": round(loss_val, 4),
+    }))
+
+
+def bench_bert():
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.bert import Bert, bert_config
+
+    n_dev = jax.device_count()
+    preset = os.environ.get("BENCH_MODEL", "bert-large")
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    micro = int(os.environ.get("BENCH_MICRO", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "16"))
+
+    cfg = bert_config(preset, max_position_embeddings=max(seq, 128),
+                      scan_layers=True,
+                      attn_impl=os.environ.get("BENCH_ATTN", "auto"),
+                      remat=os.environ.get("BENCH_REMAT", "0") == "1")
+    model = Bert(cfg)
+    engine = _train_engine(model, micro, 1)
+
+    rng = np.random.default_rng(0)
+    global_batch = micro * n_dev
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, global_batch, seq)), jnp.int32)
+    batch = (ids, ids)
     for _ in range(2):
         loss = engine.train_batch(batch=batch)
     float(loss)
 
-    def chain(n):
-        t0 = time.perf_counter()
-        loss = None
-        for _ in range(n):
-            loss = engine.train_batch(batch=batch)
-        out = float(loss)
-        return time.perf_counter() - t0, out
+    per_step, loss_val = _chain_timer(
+        lambda: engine.train_batch(batch=batch), lambda l: float(l), steps=steps)
 
-    base_n = 3
-    d_short, _ = chain(base_n)
-    d_long, loss_val = chain(base_n + steps)
-    per_step = (d_long - d_short) / steps
-
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(engine.state.params))
+    flops_tok = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
     samples_per_sec = global_batch / per_step
-    tokens_per_sec = samples_per_sec * seq
-    tflops_per_chip = tokens_per_sec * model.flops_per_token(seq) / n_dev / 1e12
-
+    tflops = samples_per_sec * seq * flops_tok / n_dev / 1e12
     print(json.dumps({
-        "metric": f"{preset} train TFLOPs/chip (seq={seq}, micro={micro}, "
-                  f"{n_dev}x{jax.devices()[0].platform})",
-        "value": round(tflops_per_chip, 3),
+        "metric": f"{preset} MLM train TFLOPs/chip (seq={seq}, micro={micro}, "
+                  f"ZeRO-1, {n_dev}x{jax.devices()[0].platform})",
+        "value": round(tflops, 3),
         "unit": "TFLOPs/chip",
-        "vs_baseline": round(tflops_per_chip / 64.0, 4),
+        "vs_baseline": round(tflops / 64.0, 4),
         "samples_per_sec": round(samples_per_sec, 2),
         "loss": round(loss_val, 4),
     }))
+
+
+def bench_decode():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+
+    n_dev = jax.device_count()
+    preset = os.environ.get("BENCH_MODEL", "gpt2")
+    B = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    prompt = int(os.environ.get("BENCH_SEQ", "128"))
+    new = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+    trials = int(os.environ.get("BENCH_STEPS", "8"))
+
+    cfg = gpt_config(preset, n_positions=prompt + new, scan_layers=True)
+    model = GPT(cfg)
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": os.environ.get("BENCH_DTYPE", "bfloat16")})
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt)), jnp.int32)
+    out = engine.generate(ids, max_new_tokens=new)   # compile
+    int(np.asarray(out)[0, -1])
+
+    per_gen, _ = _chain_timer(
+        lambda: engine.generate(ids, max_new_tokens=new),
+        lambda o: int(np.asarray(o)[0, -1]), base_n=1, steps=trials)
+
+    tokens_per_sec = B * new / per_gen
+    # actual stored weight bytes (mixed dtypes: int8 payloads keep bf16
+    # embeddings + fp32 scales), per chip — each decode step streams one
+    # chip's weight shard once (batch amortizes): the memory-bound
+    # decode roofline
+    weight_bytes = sum(l.size * l.dtype.itemsize
+                       for l in jax.tree.leaves(engine.params)) / n_dev
+    hbm_read_gbps = (new / per_gen) * weight_bytes / 1e9
+    print(json.dumps({
+        "metric": f"{preset} decode tokens/sec (batch={B}, prompt={prompt}, "
+                  f"new={new}, {n_dev}x{jax.devices()[0].platform})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(hbm_read_gbps / V5E_HBM_GBPS, 4),
+        "tokens_per_sec_per_seq": round(new / per_gen, 1),
+        "weight_stream_GBps": round(hbm_read_gbps, 1),
+    }))
+
+
+def main():
+    mode = os.environ.get("BENCH_MODE", "train")
+    {"train": bench_train, "bert": bench_bert, "decode": bench_decode}[mode]()
 
 
 if __name__ == "__main__":

@@ -296,15 +296,18 @@ def _split_qkv(cfg: "GPTConfig", qkv: Array):
             v.reshape(B, S, Hkv, D))
 
 
-def _expand_kv(cfg: "GPTConfig", k: Array) -> Array:
-    """Repeat KV heads up to n_head for the attention op."""
-    if cfg.kv_heads == cfg.n_head:
-        return k
-    return jnp.repeat(k, cfg.n_head // cfg.kv_heads, axis=2)
+def _wget(p: Dict, key: str, dt) -> Array:
+    """Weight fetch that transparently dequantizes int8-injected params
+    (``module_inject/quantization.py``; reference GroupQuantizer +
+    ``dequantize.cu``) — same model code serves fp and int8 weights."""
+    w = p[key]
+    if isinstance(w, dict) and "q8" in w:
+        return w["q8"].astype(dt) * w["scale"].astype(dt)
+    return w.astype(dt)
 
 
 def _mlp(cfg: "GPTConfig", p: Dict, h: Array, dt) -> Array:
-    up = h @ p["fc_w"].astype(dt)
+    up = h @ _wget(p, "fc_w", dt)
     if cfg.use_bias:
         up = up + p["fc_b"].astype(dt)
     if cfg.mlp_type == "swiglu":
@@ -312,7 +315,7 @@ def _mlp(cfg: "GPTConfig", p: Dict, h: Array, dt) -> Array:
         h = jax.nn.silu(gate) * val
     else:
         h = _activation(up, cfg.activation)
-    out = h @ p["proj_w"].astype(dt)
+    out = h @ _wget(p, "proj_w", dt)
     if cfg.use_bias:
         out = out + p["proj_b"].astype(dt)
     return out
@@ -344,7 +347,7 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
 
     with jax.named_scope("attn"):
         h = _norm(cfg, x, p["ln1_g"], p["ln1_b"])
-        qkv = h @ p["qkv_w"].astype(dt)
+        qkv = h @ _wget(p, "qkv_w", dt)
         if cfg.use_bias:
             qkv = qkv + p["qkv_b"].astype(dt)
         q, k, v = _split_qkv(cfg, qkv)
@@ -352,19 +355,23 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
             pos = jnp.arange(S)
             q = apply_rope(q, pos, cfg.rope_theta)
             k = apply_rope(k, pos, cfg.rope_theta)
-        k = _expand_kv(cfg, k)
-        v = _expand_kv(cfg, v)
+        # grouped K/V go to the attention op as-is: the Pallas kernel (and
+        # the GQA-aware jnp reference) consume Hkv < H heads natively, so
+        # training saves the K/V-expansion HBM the round-3 path paid here
         # heads sharded over tensor axis (Megatron attention parallelism)
         q = _constrain(q, mesh_lib.BATCH_AXES, "seq", "tensor", None)
         k = _constrain(k, mesh_lib.BATCH_AXES, "seq", "tensor", None)
         v = _constrain(v, mesh_lib.BATCH_AXES, "seq", "tensor", None)
         if cfg.position_encoding == "alibi":
-            from deepspeed_tpu.ops.attention import alibi_bias
-            o = attention_fn(q, k, v, causal=True, bias=alibi_bias(H, S, S))
+            # slopes-only ALiBi: every attention path synthesizes the bias
+            # from iotas (O(H) memory — no [S, S] bias tensor ever exists)
+            from deepspeed_tpu.ops.attention import alibi_slopes
+            o = attention_fn(q, k, v, causal=True,
+                             alibi=jnp.asarray(alibi_slopes(H)))
         else:
             o = attention_fn(q, k, v, causal=True)
         o = o.reshape(B, S, E)
-        o = o @ p["out_w"].astype(dt)
+        o = o @ _wget(p, "out_w", dt)
         if cfg.use_bias:
             o = o + p["out_b"].astype(dt)
         x = x + _dropout(o, cfg.dropout, r[0], train)
@@ -380,7 +387,8 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
 def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
                 rng: Optional[Array] = None, train: bool = False,
                 attention_fn: Optional[Callable] = None,
-                pld_theta: Optional[Array] = None) -> Array:
+                pld_theta: Optional[Array] = None,
+                return_hidden: bool = False) -> Array:
     """Logits ``[batch, seq, padded_vocab]`` (bf16 compute, fp32 logits).
 
     ``pld_theta`` enables progressive layer drop (reference
@@ -397,10 +405,21 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
     B, S = input_ids.shape
     dt = cfg.dtype
     with jax.named_scope("embed"):
-        x = params["wte"].astype(dt)[input_ids]
+        # Explicit ZeRO-3 gather for the embedding table: under stage 3 the
+        # policy shards wte's E dim over fsdp, and a table gather with a
+        # sharded E produces E-sharded activations that the partitioner can
+        # only reshard to the batch/seq layout by full replication (the
+        # "involuntary full rematerialization" warnings of MULTICHIP_r03).
+        # Constraining the table to its logical (vocab-parallel, E-whole)
+        # spec first makes the gather-at-use all-gather explicit — which is
+        # what ZeRO-3 does for every parameter anyway — and the gather then
+        # lands batch/seq-sharded directly.
+        input_ids = _constrain(input_ids, mesh_lib.BATCH_AXES, "seq")
+        wte = _constrain(params["wte"], "tensor", None)
+        x = wte.astype(dt)[input_ids]
+        x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
         if cfg.position_encoding == "learned":
             x = x + params["wpe"].astype(dt)[:S][None]
-        x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
         x = _dropout(x, cfg.dropout, rng, train)
 
     body = partial(gpt_block, cfg, train=train, attention_fn=attention_fn)
@@ -464,6 +483,8 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
 
     with jax.named_scope("head"):
         x = _norm(cfg, x, params["lnf_g"], params["lnf_b"])
+        if return_hidden:   # training loss path: chunked CE owns the head
+            return x
         # tied embedding projection (or the untied lm_head when the source
         # checkpoint has one); vocab-parallel → logits sharded over tensor
         head = params["lm_head"] if cfg.untied_head else params["wte"]
@@ -471,14 +492,78 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
     return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
 
 
+def chunked_cross_entropy(x: Array, head: Array, labels: Array,
+                          vocab_size: int, n_chunks: int = 0) -> Array:
+    """Cross-entropy over the unembedding WITHOUT materializing [N, V]
+    logits: rows are processed in chunks under ``jax.checkpoint``, so both
+    forward and backward hold one [chunk, V] logits block at a time (the
+    backward recomputes the chunk's logits and forms softmax-minus-onehot
+    in place).  At GPT-2 vocab and micro-batch 16×512 this removes ~5 GiB
+    of fp32 logits/softmax temporaries from the training step — the memory
+    cliff that capped the round-3 headline bench at micro 16.
+
+    x: [B, S, E] final hidden; head: [V, E]; labels: [B, S].
+    ``n_chunks=0`` picks the smallest count keeping a chunk's logits block
+    under ~256 MiB.
+    """
+    B, S, E = x.shape
+    V = head.shape[0]
+    N = B * S
+    if n_chunks <= 0:
+        # chunking trades ~1/3 extra head FLOPs (backward recompute) for
+        # the [N, V] memory — only worth it once the logits block is big
+        # enough to threaten HBM (measured crossover on v5e-16GB: micro 16
+        # x 512 x 50k vocab = 1.65 GiB fits comfortably unchunked)
+        if N * V * 4 <= 1800 * 2 ** 20:
+            n_chunks = 1
+        else:
+            target_rows = max(1, (256 * 2 ** 20) // (4 * V))
+            n_chunks = max(1, N // target_rows)
+    while N % n_chunks:
+        n_chunks += 1
+    rows = N // n_chunks
+    if n_chunks == 1:
+        logits = (x.reshape(N, E) @ head.astype(x.dtype).T).astype(jnp.float32)
+        if V != vocab_size:
+            logits = jnp.where(jnp.arange(V)[None] < vocab_size, logits, -1e9)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        ll = jnp.sum(logits * jax.nn.one_hot(labels.reshape(N), V,
+                                             dtype=logits.dtype), axis=-1)
+        return jnp.mean(lse - ll)
+    xc = x.reshape(n_chunks, rows, E)
+    lc = labels.reshape(n_chunks, rows)
+    mask_pad = V != vocab_size
+
+    def chunk(total, xs):
+        xch, lch = xs
+        logits = (xch @ head.astype(xch.dtype).T).astype(jnp.float32)  # [rows, V]
+        if mask_pad:
+            logits = jnp.where(jnp.arange(V)[None] < vocab_size, logits, -1e9)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        # one-hot contraction, not take_along_axis: under TP the logits are
+        # vocab-parallel and a gather's vjp (scatter on the sharded dim)
+        # provokes pathological SPMD partitioner compiles (same issue as
+        # gpt_ce_loss_fn); XLA fuses the one-hot select without
+        # materializing it
+        ll = jnp.sum(logits * jax.nn.one_hot(lch, V, dtype=logits.dtype),
+                     axis=-1)
+        return total + jnp.sum(lse - ll), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(chunk), jnp.zeros((), jnp.float32),
+                            (xc, lc))
+    return total / N
+
+
 def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
              rng: Optional[Array] = None, train: bool = True,
              attention_fn: Optional[Callable] = None,
              pld_theta: Optional[Array] = None) -> Array:
-    """Next-token cross-entropy, masking padded vocab entries."""
-    logits = gpt_forward(cfg, params, input_ids, rng, train, attention_fn,
-                         pld_theta=pld_theta)
-    return gpt_ce_loss_fn(cfg)(logits, labels)
+    """Next-token cross-entropy, masking padded vocab entries.  Computed
+    chunked over the head projection (no [B, S, V] logits tensor exists)."""
+    x = gpt_forward(cfg, params, input_ids, rng, train, attention_fn,
+                    pld_theta=pld_theta, return_hidden=True)
+    head = params["lm_head"] if cfg.untied_head else params["wte"]
+    return chunked_cross_entropy(x, head, labels, cfg.vocab_size)
 
 
 # --------------------------------------------------------------------------- #
@@ -555,10 +640,14 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
     else:
         attn_bias = None
 
-    def layer(x, layer_in):
-        p, ck, cv = layer_in
+    def layer(carry, p):
+        # the FULL stacked [L, B, T, Hkv, D] cache rides the scan carry and
+        # is updated in place per layer — stacked scan outputs (`ys`) would
+        # copy the whole cache every decode step (measured: ~40% of decode
+        # time went to those copies before this layout)
+        x, ck_full, cv_full, li = carry
         h = _norm(cfg, x, p["ln1_g"], p["ln1_b"])
-        qkv = h @ p["qkv_w"].astype(dt)
+        qkv = h @ _wget(p, "qkv_w", dt)
         if cfg.use_bias:
             qkv = qkv + p["qkv_b"].astype(dt)
         q, k, v = _split_qkv(cfg, qkv)
@@ -568,18 +657,25 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
             k = apply_rope(k, rpos, cfg.rope_theta)
         # the cache stores only kv_heads heads (the GQA memory win);
         # expansion to n_head happens at attention time
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=1)
+        zero = jnp.zeros((), jnp.int32)
+        ck_full = jax.lax.dynamic_update_slice(
+            ck_full, k.astype(ck_full.dtype)[None], (li, zero, pos, zero, zero))
+        cv_full = jax.lax.dynamic_update_slice(
+            cv_full, v.astype(cv_full.dtype)[None], (li, zero, pos, zero, zero))
+        ck = jax.lax.dynamic_index_in_dim(ck_full, li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_full, li, 0, keepdims=False)
         o = _cached_attention(q, ck, cv, pos, bias=attn_bias).reshape(B, S, E)
-        o = o @ p["out_w"].astype(dt)
+        o = o @ _wget(p, "out_w", dt)
         if cfg.use_bias:
             o = o + p["out_b"].astype(dt)
         x = x + o
         h = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
         h = _mlp(cfg, p, h, dt)
-        return x + h, (ck, cv)
+        return (x + h, ck_full, cv_full, li + 1), None
 
-    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["blocks"], cache["k"], cache["v"]))
+    (x, new_k, new_v, _), _ = jax.lax.scan(
+        layer, (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
+        params["blocks"])
     x = _norm(cfg, x, params["lnf_g"], params["lnf_b"])
     head = params["lm_head"] if cfg.untied_head else params["wte"]
     logits = (x @ head.astype(dt).T).astype(jnp.float32)
@@ -589,7 +685,8 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
 
 def gpt_generate(cfg: GPTConfig, params: Dict, input_ids: Array,
                  max_new_tokens: int, rng: Optional[Array] = None,
-                 temperature: float = 0.0, max_len: Optional[int] = None) -> Array:
+                 temperature: float = 0.0, max_len: Optional[int] = None,
+                 prompt_len: Optional[Array] = None) -> Array:
     """Greedy (temperature=0) or sampled autoregressive generation.
     The decode loop is one ``lax.scan`` — a single compiled program for all
     steps (the analogue of the reference's CUDA-graph'd generate,
@@ -601,7 +698,19 @@ def gpt_generate(cfg: GPTConfig, params: Dict, input_ids: Array,
     max_len = max_len or (S + max_new_tokens)
     cache = init_kv_cache(cfg, B, max_len)
     logits, cache = gpt_apply_with_cache(cfg, params, input_ids, cache)
-    last = logits[:, -1]
+    if prompt_len is None:
+        last = logits[:, -1]
+    else:
+        # bucketed serving: the prompt is right-padded to a bucketed S and
+        # ``prompt_len`` (traced) marks the real length — one compiled
+        # program covers every prompt length in the bucket.  Causality makes
+        # right-padding benign: positions < prompt_len never attend to the
+        # pad tail, and decode overwrites the tail's K/V slot-by-slot
+        # (step i writes position prompt_len + i before reading it).
+        idx = jnp.broadcast_to(jnp.reshape(prompt_len - 1, (1, 1, 1)),
+                               (B, 1, logits.shape[-1]))
+        last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+        cache = dict(cache, pos=jnp.asarray(prompt_len, jnp.int32))
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def sample(logits, r):
@@ -692,7 +801,14 @@ def gpt_ce_loss_fn(cfg: GPTConfig):
             mask = jnp.arange(cfg.padded_vocab) < cfg.vocab_size
             logits = jnp.where(mask[None, None, :], logits, -1e9)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        # one-hot contraction, NOT take_along_axis: logits are
+        # vocab-parallel (sharded 'tensor'), and the vjp of a gather on a
+        # sharded dim (a scatter) sends the SPMD partitioner into a
+        # pathological compile inside the 1F1B pipeline's scan; the
+        # contraction partitions as a local reduce + psum and XLA fuses
+        # the one-hot select without materializing it
+        onehot = jax.nn.one_hot(labels, logp.shape[-1], dtype=logp.dtype)
+        ll = jnp.sum(logp * onehot, axis=-1)
         return -jnp.mean(ll)
     return loss_fn
 
@@ -765,9 +881,10 @@ class GPT:
         return gpt_forward(self.cfg, params, input_ids, rng=None, train=False)
 
     def generate(self, params, input_ids, max_new_tokens, rng=None,
-                 temperature: float = 0.0):
+                 temperature: float = 0.0, prompt_len=None):
         return gpt_generate(self.cfg, params, input_ids, max_new_tokens,
-                            rng=rng, temperature=temperature)
+                            rng=rng, temperature=temperature,
+                            prompt_len=prompt_len)
 
     def num_params(self) -> int:
         cfg = self.cfg

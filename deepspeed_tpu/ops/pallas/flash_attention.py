@@ -6,6 +6,32 @@ of ``csrc/transformer/ds_transformer_cuda.cpp``).  Online-softmax tiling:
 O(S) memory, MXU-shaped [128, head_dim] tiles, fp32 accumulation, bf16
 operands.
 
+Capabilities beyond the round-3 kernel:
+
+* **Grouped-query attention** — K/V may carry ``Hkv < H`` heads
+  (``H % Hkv == 0``).  The kernel maps query head ``h`` onto KV head
+  ``h // (H//Hkv)`` via the BlockSpec index map, so grouped K/V are never
+  materialized at full head count (the reference expands on the host;
+  round 3 expanded in ``models/gpt.py:_expand_kv`` — both pay HBM for it).
+  The backward dK/dV kernel grids over *KV* heads and accumulates the
+  group's query heads in-register.
+
+* **In-kernel ALiBi** — ``alibi`` takes the per-head slopes (an [H] vector,
+  O(H) memory) and the kernel computes ``slope * (k_pos - q_pos)`` from
+  iotas on the VPU: zero HBM traffic for the bias, so BLOOM-style models
+  ride the flash path at any sequence length.  The reference bakes alibi
+  into its softmax kernel the same way
+  (``csrc/transformer/inference/csrc/softmax.cu``).
+
+* **Additive logit bias** — an optional dense ``bias`` operand
+  broadcastable to ``[B, H, S, S]`` (relative-position bias and other
+  non-ALiBi biases), added to the scaled scores before the online softmax.
+  Inherently O(S^2) HBM (the caller materialized it); prefer ``alibi``
+  when the bias is ALiBi-shaped.  Both bias forms are CONSTANTS under
+  differentiation: gradients flow to q/k/v but not to the bias (a learned
+  T5-style bias would need an O(S^2) dbias output that defeats flash
+  memory scaling).
+
 Layout convention here is [batch, heads, seq, head_dim]; the public wrapper
 (`flash_attention`) takes the framework-wide [batch, seq, heads, head_dim].
 
@@ -62,13 +88,39 @@ def _block_sizes(S: int, bq: Optional[int], bk: Optional[int]):
     return bq, bk
 
 
+def _bias_spec_qrows(bias, bq, S):
+    """BlockSpec for a [Bb, Hb, S, S] bias on the (b, h, i)-gridded kernels
+    (q-block rows, full-S columns), honoring batch/head broadcast."""
+    bsel = (lambda b: b) if bias.shape[0] > 1 else (lambda b: 0)
+    hsel = (lambda h: h) if bias.shape[1] > 1 else (lambda h: 0)
+    return pl.BlockSpec((1, 1, bq, S), lambda b, h, i: (bsel(b), hsel(h), i, 0))
+
+
+def _bias_spec_kcols(bias, group, bk, S):
+    """BlockSpec for the dKV kernel's (b, h_kv, j) grid: full-S q rows,
+    KV-block columns, the query-head group stacked in dim 1 (or broadcast)."""
+    bsel = (lambda b: b) if bias.shape[0] > 1 else (lambda b: 0)
+    if bias.shape[1] > 1:
+        return pl.BlockSpec((1, group, S, bk), lambda b, h, j: (bsel(b), h, 0, j))
+    return pl.BlockSpec((1, 1, S, bk), lambda b, h, j: (bsel(b), 0, 0, j))
+
+
 # --------------------------------------------------------------------------- #
 # Forward
 # --------------------------------------------------------------------------- #
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, S):
+def _fwd_kernel(*refs, scale, causal, bq, bk, S, has_bias, has_alibi):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    n = 3
+    b_ref = refs[n] if has_bias else None
+    n += has_bias
+    a_ref = refs[n] if has_alibi else None
+    n += has_alibi
+    o_ref, lse_ref = refs[n:]
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
     D = q.shape[-1]
+    slope = a_ref[pl.program_id(1)] if has_alibi else None
 
     if causal:
         num_kb = pl.cdiv((qi + 1) * bq, bk)
@@ -81,9 +133,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, S
         v = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
+        if has_bias:
+            s = s + b_ref[0, 0, :, pl.ds(j * bk, bk)].astype(jnp.float32)
+        if causal or has_alibi:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if has_alibi:   # slope * (k_pos - q_pos), computed on the VPU
+            s = s + slope * (cols - rows).astype(jnp.float32)
+        if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -101,18 +158,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, S
     lse_ref[0, 0] = m + jnp.log(l)        # [bq, 1]
 
 
-def _fwd(q, k, v, *, causal, scale, bq=None, bk=None):
+def _fwd(q, k, v, bias, slopes, *, causal, scale, bq=None, bk=None):
     B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    group = H // Hkv
     bq, bk = _block_sizes(S, bq, bk)
     grid = (B, H, S // bq)
-    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+        kv_spec, kv_spec,
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec_qrows(bias, bq, S))
+        args.append(bias)
+    if slopes is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM))
+        args.append(slopes)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, S=S),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+                          S=S, has_bias=bias is not None,
+                          has_alibi=slopes is not None),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-            kv_spec, kv_spec,
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
@@ -123,21 +192,29 @@ def _fwd(q, k, v, *, causal, scale, bq=None, bk=None):
         ],
         compiler_params=_PARALLEL3,
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
 # --------------------------------------------------------------------------- #
 # Backward
 # --------------------------------------------------------------------------- #
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, bq, bk, S):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, S, has_bias, has_alibi):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    n = 6
+    b_ref = refs[n] if has_bias else None
+    n += has_bias
+    a_ref = refs[n] if has_alibi else None
+    n += has_alibi
+    dq_ref = refs[n]
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0]                   # [bq, 1]
     delta = delta_ref[0, 0]               # [bq, 1]
     D = q.shape[-1]
+    slope = a_ref[pl.program_id(1)] if has_alibi else None
 
     num_kb = pl.cdiv((qi + 1) * bq, bk) if causal else S // bk
 
@@ -146,9 +223,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if has_bias:
+            s = s + b_ref[0, 0, :, pl.ds(j * bk, bk)].astype(jnp.float32)
+        if causal or has_alibi:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if has_alibi:
+            s = s + slope * (cols - rows).astype(jnp.float32)
+        if causal:
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                                   # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -161,113 +243,181 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, bq, bk, S):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, S, group, has_bias,
+                    bias_per_head, has_alibi):
+    """Grid (B, Hkv, S//bk): one KV block per step, accumulating dK/dV over
+    the ``group`` query heads that attend to this KV head."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    n = 6
+    b_ref = refs[n] if has_bias else None
+    n += has_bias
+    a_ref = refs[n] if has_alibi else None
+    n += has_alibi
+    dk_ref, dv_ref = refs[n:]
     ki = pl.program_id(2)
+    # program_id must bind at kernel top level (not inside the fori_loop
+    # body, where interpret mode can't re-associate it with the grid)
+    hk = pl.program_id(1)
     k = k_ref[0, 0].astype(jnp.float32)   # [bk, D]
     v = v_ref[0, 0].astype(jnp.float32)
     D = k.shape[-1]
     num_qb = S // bq
     start_qb = (ki * bk) // bq if causal else 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * bq, bq), :]       # [bq, 1]
-        delta = delta_ref[0, 0, pl.ds(i * bq, bq), :]   # [bq, 1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                                    # [bq, bk]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                           # [bq, bk]
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+    dk = jnp.zeros((bk, D), jnp.float32)
+    dv = jnp.zeros((bk, D), jnp.float32)
+    for g in range(group):      # static unroll over the query-head group
+        slope = a_ref[hk * group + g] if has_alibi else None
 
-    z = jnp.zeros((bk, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (z, z))
+        def body(i, carry, g=g, slope=slope):
+            dk, dv = carry
+            q = q_ref[0, g, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            do = do_ref[0, g, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            lse = lse_ref[0, g, pl.ds(i * bq, bq), :]       # [bq, 1]
+            delta = delta_ref[0, g, pl.ds(i * bq, bq), :]   # [bq, 1]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                gb = g if bias_per_head else 0
+                s = s + b_ref[0, gb, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            if causal or has_alibi:
+                rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            if has_alibi:
+                s = s + slope * (cols - rows).astype(jnp.float32)
+            if causal:
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse)                                    # [bq, bk]
+            dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale                           # [bq, bk]
+            dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+            return dk, dv
+
+        dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (dk, dv))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
 def _bwd(causal, scale, bq, bk, res, do):
-    q, k, v, o, lse = res
+    q, k, v, bias, slopes, o, lse = res
     B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    group = H // Hkv
     bq_, bk_ = _block_sizes(S, bq, bk)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1, keepdims=True)                     # [B,H,S,1]
 
     qspec = pl.BlockSpec((1, 1, bq_, D), lambda b, h, i: (b, h, i, 0))
-    full = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0))
+    kv_full = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0))
     vec_q = pl.BlockSpec((1, 1, bq_, 1), lambda b, h, i: (b, h, i, 0))
-    vec_full = pl.BlockSpec((1, 1, S, 1), lambda b, h, i: (b, h, 0, 0))
 
+    dq_in = [q, k, v, do, lse, delta]
+    dq_specs = [qspec, kv_full, kv_full, qspec, vec_q, vec_q]
+    if bias is not None:
+        dq_in.append(bias)
+        dq_specs.append(_bias_spec_qrows(bias, bq_, S))
+    if slopes is not None:
+        dq_in.append(slopes)
+        dq_specs.append(pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq_, bk=bk_, S=S),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq_,
+                          bk=bk_, S=S, has_bias=bias is not None,
+                          has_alibi=slopes is not None),
         grid=(B, H, S // bq_),
-        in_specs=[qspec, full, full, qspec, vec_q, vec_q],
+        in_specs=dq_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         compiler_params=_PARALLEL3,
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dq_in)
 
+    # dK/dV: grid over KV heads; q/do/lse/delta delivered group-at-a-time
     kspec = pl.BlockSpec((1, 1, bk_, D), lambda b, h, j: (b, h, j, 0))
+    q_grp = pl.BlockSpec((1, group, S, D), lambda b, h, j: (b, h, 0, 0))
+    vec_grp = pl.BlockSpec((1, group, S, 1), lambda b, h, j: (b, h, 0, 0))
+    dkv_in = [q, k, v, do, lse, delta]
+    dkv_specs = [q_grp, kspec, kspec, q_grp, vec_grp, vec_grp]
+    bias_per_head = bias is not None and bias.shape[1] > 1
+    if bias is not None:
+        dkv_in.append(bias)
+        dkv_specs.append(_bias_spec_kcols(bias, group, bk_, S))
+    if slopes is not None:
+        dkv_in.append(slopes)
+        dkv_specs.append(pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq_, bk=bk_, S=S),
-        grid=(B, H, S // bk_),
-        in_specs=[full, kspec, kspec, full, vec_full, vec_full],
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq_,
+                          bk=bk_, S=S, group=group, has_bias=bias is not None,
+                          bias_per_head=bias_per_head,
+                          has_alibi=slopes is not None),
+        grid=(B, Hkv, S // bk_),
+        in_specs=dkv_specs,
         out_specs=[kspec, kspec],
-        out_shape=[jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
-                   jax.ShapeDtypeStruct((B, H, S, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, Hkv, S, D), v.dtype)],
         compiler_params=_PARALLEL3,
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(*dkv_in)
+    # both bias forms are constants under differentiation (module docstring)
+    db = None if bias is None else jnp.zeros_like(bias)
+    da = None if slopes is None else jnp.zeros_like(slopes)
+    return dq, dk, dv, db, da
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, bq, bk):
-    o, _ = _fwd(q, k, v, causal=causal, scale=scale, bq=bq, bk=bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, bias, slopes, causal, scale, bq, bk):
+    o, _ = _fwd(q, k, v, bias, slopes, causal=causal, scale=scale, bq=bq, bk=bk)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, bq, bk):
-    o, lse = _fwd(q, k, v, causal=causal, scale=scale, bq=bq, bk=bk)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, bias, slopes, causal, scale, bq, bk):
+    o, lse = _fwd(q, k, v, bias, slopes, causal=causal, scale=scale, bq=bq, bk=bk)
+    return o, (q, k, v, bias, slopes, o, lse)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
 
 
-def _flash_bshd(q, k, v, causal, scale, bq, bk):
-    """[B,S,H,D] wrapper around the [B,H,S,D] kernel."""
+def _flash_bshd(q, k, v, bias, slopes, causal, scale, bq, bk):
+    """[B,S,H,D] wrapper around the [B,H,S,D] kernel (grouped-KV aware)."""
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    o = _flash(qt, kt, vt, causal, scale, bq, bk)
+    o = _flash(qt, kt, vt, bias, slopes, causal, scale, bq, bk)
     return o.transpose(0, 2, 1, 3)
 
 
-def flash_attention(q, k, v, *, causal: bool = True,
+def flash_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None,
                     block_q: Optional[int] = None, block_k: Optional[int] = None):
     """[batch, seq, heads, head_dim] flash attention (differentiable).
+
+    ``k``/``v`` may carry fewer heads than ``q`` (GQA/MQA; ``H % Hkv == 0``)
+    — the kernel indexes grouped KV directly, no host-side expansion.
+    ``alibi`` is the per-head slope vector [H]; the kernel synthesizes the
+    ALiBi bias from iotas (O(H) memory).  ``bias`` is a dense additive
+    logit bias broadcastable to [B, H, S, S].  Both are constants under
+    differentiation.
 
     Under an active mesh the kernel runs inside ``shard_map`` with batch
     sharded over the data/fsdp/expert axes and heads over seq × tensor
     (sequence-sharded inputs are thereby Ulysses-re-sharded to full-seq,
     split-head form before the kernel — see module docstring)."""
+    from deepspeed_tpu.ops.attention import canonical_bias
     B, S, H, D = q.shape
-    if S % min(128, S) != 0:
+    Hkv = k.shape[2]
+    if S % min(128, S) != 0 or H % Hkv != 0:
         from deepspeed_tpu.ops.attention import reference_attention
-        return reference_attention(q, k, v, causal=causal)
+        return reference_attention(q, k, v, causal=causal, bias=bias, alibi=alibi)
     scale = 1.0 / np.sqrt(D)
+    bias = canonical_bias(bias)
+    if bias is not None:
+        bias = jnp.broadcast_to(
+            bias, (bias.shape[0], bias.shape[1], S, S)).astype(jnp.float32)
+    slopes = None
+    if alibi is not None:
+        slopes = jnp.asarray(alibi, jnp.float32).reshape(H)
 
     from deepspeed_tpu.parallel import mesh as mesh_lib
     if mesh_lib.has_mesh() and not mesh_lib.in_manual_mode():
@@ -275,14 +425,30 @@ def flash_attention(q, k, v, *, causal: bool = True,
         batch_div = int(np.prod([mesh.shape[a] for a in mesh_lib.BATCH_AXES]))
         head_div = int(mesh.shape["tensor"] * mesh.shape["seq"])
         if batch_div > 1 or head_div > 1:
-            if B % batch_div != 0 or H % head_div != 0:
+            if B % batch_div != 0 or H % head_div != 0 or Hkv % head_div != 0:
                 # a bare pallas_call has no SPMD partitioning rule; on shapes
                 # the shard_map can't split, use the jnp path XLA can shard
                 from deepspeed_tpu.ops.attention import reference_attention
-                return reference_attention(q, k, v, causal=causal)
+                return reference_attention(q, k, v, causal=causal, bias=bias,
+                                           alibi=alibi)
             spec = P(mesh_lib.BATCH_AXES, None, ("seq", "tensor"), None)
-            inner = functools.partial(_flash_bshd, causal=causal, scale=scale,
-                                      bq=block_q, bk=block_k)
-            return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                                 out_specs=spec, check_vma=False)(q, k, v)
-    return _flash_bshd(q, k, v, causal, scale, block_q, block_k)
+            in_specs = [spec, spec, spec]
+            args = [q, k, v]
+            if bias is not None:
+                in_specs.append(P(mesh_lib.BATCH_AXES if bias.shape[0] > 1 else None,
+                                  ("seq", "tensor") if bias.shape[1] > 1 else None,
+                                  None, None))
+                args.append(bias)
+            if slopes is not None:
+                in_specs.append(P(("seq", "tensor")))
+                args.append(slopes)
+            nb, ns = bias is not None, slopes is not None
+
+            def inner(q, k, v, *rest):
+                b = rest[0] if nb else None
+                sl = rest[-1] if ns else None
+                return _flash_bshd(q, k, v, b, sl, causal, scale, block_q, block_k)
+
+            return jax.shard_map(inner, mesh=mesh, in_specs=tuple(in_specs),
+                                 out_specs=spec, check_vma=False)(*args)
+    return _flash_bshd(q, k, v, bias, slopes, causal, scale, block_q, block_k)

@@ -17,7 +17,14 @@ TPU-native schemes over the ``seq`` mesh axis:
   materializes the full sequence anywhere.  shard_map manual over ``seq``.
 
 Both keep the framework-wide attention signature
-``fn(q, k, v, *, causal) -> out`` with ``[batch, seq, heads, head_dim]``.
+``fn(q, k, v, *, causal, bias=None, alibi=None) -> out`` with
+``[batch, seq, heads, head_dim]``.  ALiBi goes through ``alibi`` (per-head
+slopes, [H]): the ring body synthesizes ``slope * (k_pos - q_pos)`` from
+global position iotas each hop — O(H) memory, so BLOOM-style models train
+sequence-parallel at any length.  A dense ``bias`` (rel-pos etc.) is also
+supported: its Q rows are sharded with the local shard and KV-block columns
+are dynamic-sliced per hop (O(Hb·S/sp·S) per device — inherent to a dense
+O(S^2) bias the caller already materialized; prefer ``alibi``).
 """
 
 from functools import partial
@@ -36,11 +43,11 @@ NEG_INF = -1e30
 _constrain = mesh_lib.constrain
 
 
-def ulysses_attention(q, k, v, *, causal: bool = True,
+def ulysses_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None,
                       inner: Optional[Callable] = None):
     """All-to-all head/sequence re-sharding attention (DeepSpeed-Ulysses
     scheme, built after the reference's era).  Requires ``heads % sp == 0``."""
-    from deepspeed_tpu.ops.attention import reference_attention
+    from deepspeed_tpu.ops.attention import reference_attention, canonical_bias
     inner = inner or reference_attention
     B = mesh_lib.BATCH_AXES
     # seq-sharded on entry (the transformer keeps activations seq-sharded);
@@ -48,13 +55,21 @@ def ulysses_attention(q, k, v, *, causal: bool = True,
     q, k, v = (_constrain(x, B, "seq", "tensor", None) for x in (q, k, v))
     # a2a: full sequence, heads split over seq x tensor
     q, k, v = (_constrain(x, B, None, ("seq", "tensor"), None) for x in (q, k, v))
-    o = inner(q, k, v, causal=causal)
+    bias = canonical_bias(bias)
+    if bias is not None and bias.shape[1] > 1:
+        # per-head bias follows the head sharding; the inner kernel slices it
+        bias = _constrain(bias, None, ("seq", "tensor"), None, None)
+    o = inner(q, k, v, causal=causal, bias=bias, alibi=alibi)
     # a2a back to seq-sharded
     return _constrain(o, B, "seq", "tensor", None)
 
 
-def _ring_body(q, k, v, *, causal: bool, sp: int):
-    """shard_map body: q/k/v are local shards [B, Sl, H, D]."""
+def _ring_body(q, k, v, bias, slopes, *, causal: bool, sp: int):
+    """shard_map body: q/k/v are local shards [B, Sl, H, D].  ``bias`` (or
+    None) is the local Q-row slice [Bb, Hb, Sl|1, S] of the dense bias —
+    columns for the in-flight KV block are dynamic-sliced each hop.
+    ``slopes`` (or None) is the [H] ALiBi vector; the bias term is rebuilt
+    from global position iotas per hop (no [S, S] materialization)."""
     idx = jax.lax.axis_index("seq")
     Bq, Sl, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
@@ -66,9 +81,16 @@ def _ring_body(q, k, v, *, causal: bool, sp: int):
         m, l, acc, kc, vc = carry
         src = (idx - j) % sp
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)) * scale
-        if causal:
+        if causal or slopes is not None:
             rows = idx * Sl + jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
             cols = src * Sl + jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
+        if bias is not None:
+            bcols = jax.lax.dynamic_slice_in_dim(bias, src * Sl, Sl, axis=3)
+            s = s + bcols.astype(jnp.float32)
+        if slopes is not None:   # ALiBi from iotas: slope * (k_pos - q_pos)
+            dist = (cols - rows).astype(jnp.float32)
+            s = s + slopes.astype(jnp.float32)[None, :, None, None] * dist[None, None]
+        if causal:
             s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))   # [B,H,Sl,1]
         p = jnp.exp(s - m_new)                                        # [B,H,Sl,Sl]
@@ -88,20 +110,45 @@ def _ring_body(q, k, v, *, causal: bool, sp: int):
     return (acc / jnp.maximum(linv, 1e-30)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, *, causal: bool = True):
+def ring_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None):
     """Ring attention over the ``seq`` mesh axis (Liu et al. 2023 scheme,
-    pipelined KV ppermute).  Falls back to plain attention when sp == 1."""
-    from deepspeed_tpu.ops.attention import reference_attention
+    pipelined KV ppermute).  Falls back to plain attention when sp == 1.
+    Grouped KV is expanded per-shard (memory stays O(S/sp))."""
+    from deepspeed_tpu.ops.attention import (reference_attention,
+                                             expand_kv_heads, canonical_bias)
     if not mesh_lib.has_mesh():
-        return reference_attention(q, k, v, causal=causal)
+        return reference_attention(q, k, v, causal=causal, bias=bias, alibi=alibi)
     mesh = mesh_lib.get_mesh()
     sp = int(mesh.shape["seq"])
     if sp == 1:
-        return reference_attention(q, k, v, causal=causal)
+        return reference_attention(q, k, v, causal=causal, bias=bias, alibi=alibi)
+    k, v = expand_kv_heads(q, k, v)
+    S = q.shape[1]
+    slopes = None if alibi is None else jnp.asarray(alibi, jnp.float32)
+    bias = canonical_bias(bias)
     # partial-manual: specs may only mention the manual axis; data/fsdp/
     # tensor shardings stay automatic inside the body
     spec = PartitionSpec(None, "seq", None, None)
-    fn = jax.shard_map(partial(_ring_body, causal=causal, sp=sp),
-                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                       axis_names={"seq"}, check_vma=False)
-    return fn(q, k, v)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if bias is not None:
+        if bias.shape[3] != S:      # columns must be sliceable per hop
+            bias = jnp.broadcast_to(bias, bias.shape[:3] + (S,))
+        # Q rows travel with the local shard when present; a broadcast row
+        # dim (1) stays replicated
+        in_specs.append(PartitionSpec(
+            None, None, "seq" if bias.shape[2] == S else None, None))
+        args.append(bias)
+    if slopes is not None:
+        in_specs.append(PartitionSpec(None))
+        args.append(slopes)
+    nb, ns = bias is not None, slopes is not None
+
+    def body(q, k, v, *rest):
+        b = rest[0] if nb else None
+        sl = rest[-1] if ns else None
+        return _ring_body(q, k, v, b, sl, causal=causal, sp=sp)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=spec, axis_names={"seq"}, check_vma=False)
+    return fn(*args)

@@ -75,3 +75,104 @@ def test_gpt_with_sequence_parallel_trains():
     ids = jax.random.randint(jax.random.PRNGKey(0), (1, 4, 64), 0, cfg.vocab_size)
     losses = [float(engine.train_batch(batch=(ids, ids))) for _ in range(6)]
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+# --------------------------------------------------------------------------- #
+# Round 4: logit bias (ALiBi) + grouped KV through the SP paths
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_bias_parity(seq_mesh, causal):
+    """Ring attention with an ALiBi bias: bias Q-rows are sharded with the
+    local shard, KV-block columns dynamic-sliced per hop."""
+    from deepspeed_tpu.ops.attention import alibi_bias
+    q, k, v = make_qkv(seed=5)
+    bias = alibi_bias(4, 64, 64)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=causal, bias=bias))(q, k, v)
+    ref = reference_attention(q, k, v, causal=causal, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_bias_grad(seq_mesh):
+    from deepspeed_tpu.ops.attention import alibi_bias
+    q, k, v = make_qkv(B=1, S=32, H=2, D=8, seed=6)
+    bias = alibi_bias(2, 32, 32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=True, bias=bias) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss(ring_attention), argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=f"d{n}")
+
+
+def test_ring_attention_gqa(seq_mesh):
+    """Grouped KV through ring attention (expanded per-shard)."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (2, 64, 4, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, 2, 16), jnp.float32)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=True))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_bias_parity(seq_mesh):
+    from deepspeed_tpu.ops.attention import alibi_bias
+    q, k, v = make_qkv(seed=8)
+    bias = alibi_bias(4, 64, 64)
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, causal=True, bias=bias, inner=reference_attention))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bloom_style_sp_trains():
+    """ALiBi (BLOOM-style) model training with sequence parallelism — the
+    round-3 cliff (biased calls could not use SP at all)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+    spec = MeshSpec(data=2, seq=2, tensor=2, device_count=8)
+    mesh = spec.build(jax.devices()[:8])
+    cfg = gpt_config("tiny", n_embd=64, n_head=4, n_layer=2, vocab_size=256,
+                     n_positions=64, attn_impl="ring",
+                     position_encoding="alibi")
+    model = GPT(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 0},
+        "bf16": {"enabled": True},
+    }, mesh=mesh)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 4, 64), 0, cfg.vocab_size)
+    losses = [float(engine.train_batch(batch=(ids, ids))) for _ in range(6)]
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_ring_attention_alibi_slopes(seq_mesh):
+    """Slopes-only ALiBi through the ring — the O(H)-memory path BLOOM-style
+    long-context SP uses (no [S, S] bias tensor anywhere)."""
+    from deepspeed_tpu.ops.attention import alibi_bias, alibi_slopes
+    q, k, v = make_qkv(seed=9)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, causal=True, alibi=jnp.asarray(alibi_slopes(4))))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True, bias=alibi_bias(4, 64, 64))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_alibi_slopes(seq_mesh):
+    from deepspeed_tpu.ops.attention import alibi_bias, alibi_slopes
+    q, k, v = make_qkv(seed=10)
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, causal=True, alibi=jnp.asarray(alibi_slopes(4)),
+        inner=reference_attention))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True, bias=alibi_bias(4, 64, 64))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
