@@ -117,6 +117,10 @@ class PipelineConfig(DeepSpeedConfigModel):
     activation_checkpoint_interval: int = 0
     pipe_partitioned: bool = True
     grad_partitioned: bool = True
+    # "1f1b": per-stage interleaved fwd/bwd with stage-input recompute —
+    # live activations ∝ stages (reference TrainSchedule, pipe/schedule.py:189).
+    # "gpipe": single differentiated vmap program — activations ∝ micro-batches.
+    schedule: str = "1f1b"
 
 
 class SequenceParallelConfig(DeepSpeedConfigModel):

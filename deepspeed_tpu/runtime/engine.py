@@ -122,7 +122,15 @@ class DeepSpeedEngine:
 
         # ---- ZeRO policy ---------------------------------------------- #
         zc = self._config.zero_config
-        self.zero_policy = ZeroShardingPolicy(mesh, zc.stage, min_size=int(zc.param_shard_min_size))
+        # stage3_param_persistence_threshold (elements) is the reference's
+        # "small params stay resident" knob (zero/config.py); here resident =
+        # replicated instead of fsdp-sharded, so it folds into the sharding
+        # policy's min_size when the user raises it above the TPU-native
+        # param_shard_min_size default
+        min_size = int(zc.param_shard_min_size)
+        if "param_persistence_threshold" in zc.model_fields_set and zc.stage >= 3:
+            min_size = max(min_size, int(zc.param_persistence_threshold))
+        self.zero_policy = ZeroShardingPolicy(mesh, zc.stage, min_size=min_size)
 
         # ---- loss / model adapters ------------------------------------ #
         self._loss_fn = self._make_loss_fn(model)
@@ -752,6 +760,16 @@ class DeepSpeedEngine:
         batch = self._cast_batch(batch)
         params = self._device_view(params, self.param_shardings)
 
+        if hasattr(self.module, "value_and_grad"):
+            # the model computes its own (loss, grads) — the 1F1B pipeline
+            # interleaves forward/backward manually instead of being
+            # differentiated as one program (reference TrainSchedule,
+            # pipe/schedule.py:189).  Compression/MoQ transforms apply the
+            # same as on the autodiff path below.
+            cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), params)
+            cast = self._compress_params(cast, rng)
+            return self.module.value_and_grad(cast, batch, rng, True, scale)
+
         def scaled_loss(p):
             cast = jax.tree.map(lambda x: x.astype(self.compute_dtype), p)
             cast = self._compress_params(cast, rng)
@@ -871,10 +889,19 @@ class DeepSpeedEngine:
                 return (acc, loss_sum + loss), None
 
             gas = jax.tree.leaves(batches)[0].shape[0]
-            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
             rngs = jax.random.split(rng, gas)
-            (grads, loss_sum), _ = jax.lax.scan(micro, (zeros, jnp.zeros((), jnp.float32)),
-                                                (batches, rngs))
+            if gas == 1:
+                # no separate fp32 accumulator: at gpt2-xl scale the extra
+                # param-sized zeros buffer alone is ~6 GB of HBM + traffic
+                loss_sum, grads = self._value_and_grad(
+                    params, jax.tree.map(lambda x: x[0], batches), rngs[0],
+                    scaler.scale)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            else:
+                zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                     params)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro, (zeros, jnp.zeros((), jnp.float32)), (batches, rngs))
             new_params, new_opt, new_scaler, new_skipped, stats = self._apply_updates(
                 params, opt_state, grads, scaler, skipped)
             return (new_params, new_opt, new_scaler, new_skipped), loss_sum / gas, stats

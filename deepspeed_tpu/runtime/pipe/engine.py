@@ -4,8 +4,18 @@ Reference: ``deepspeed/runtime/pipe/engine.py:40`` (``train_batch:285``,
 ``_exec_schedule:1286`` interpreting ``TrainSchedule`` instructions with
 NCCL P2P between stage processes).
 
-TPU-first redesign — **GPipe-as-vmap under automatic SPMD**, the whole
-schedule is ONE XLA program:
+Two schedules, selected by ``pipeline.schedule`` in the config:
+
+**1f1b (default)** — ``_Pipelined1F1BModel``: per-stage programs under
+``shard_map`` manual over the ``pipe`` axis, interleaving one forward and
+one backward stage-step per tick with stage-input recompute, the analogue
+of the reference's ``TrainSchedule`` (``pipe/schedule.py:189``).  Live
+activation memory ∝ stages, not micro-batches; heterogeneous stage sizes
+via ``PipelineModule.partition()``; the embedding runs only on stage 0.
+See the class docstring for the full design and its documented trades.
+
+**gpipe** — ``_PipelinedModel``: GPipe-as-vmap under automatic SPMD, the
+whole schedule ONE differentiated XLA program:
 
 * Stage parameters are stacked on a leading axis sharded over the ``pipe``
   mesh axis; every tick ALL stages run the (identical) block stack via
@@ -22,17 +32,11 @@ schedule is ONE XLA program:
 * Memory profile is GPipe-like (all live micro-batch activations);
   ``activation_checkpoint_interval`` applies ``jax.checkpoint`` to the
   stage body, the standard TPU trade (recompute in the backward pipeline).
-
-Known redundancy (documented trade): the embed/head programs are part of
-every tick to keep the schedule SPMD, but fill/drain ticks skip their
-FLOPs through ``lax.cond`` (TPU executes one branch): the head + loss run
-only on the M ticks that complete a micro-batch and the embedding only on
-the M ticks that start one.  The remaining cost is the head being
-replicated over the ``pipe`` axis groups during steady state — the price
-of the single-program design vs the reference's per-stage processes
-(heterogeneous per-stage programs are the planned lift; until then
-``PipelineModule.partition()`` describes layouts the vmap engine does not
-consume).
+* The embed/head programs are part of every tick to keep the schedule
+  SPMD; fill/drain ticks skip their FLOPs through ``lax.cond``, but the
+  head stays replicated over the pipe groups during steady state — the
+  1f1b schedule removes the GPipe memory profile and consumes
+  ``partition()``; prefer it.
 
 Layer contract (functional analogue of the reference's layer list): each
 ``LayerSpec`` builds an object with ``init_params(rng)`` and
@@ -71,10 +75,10 @@ def _takes_kw(fn, name: str) -> bool:
         return False
 
 
-class _PipelinedModel:
-    """Adapts a ``PipelineModule`` into the engine's model contract
-    (``fn(params, batch, rng, train) -> loss``) with the pipelined
-    forward inside."""
+class _PipeModelBase:
+    """Shared spec parsing for the pipelined model adapters: first spec is
+    the embedding, last is the head, middle specs are homogeneous blocks
+    (the SPMD stacking constraint); tied embed/head pair supported."""
 
     def __init__(self, module: PipelineModule, mesh):
         self.module = module
@@ -97,9 +101,6 @@ class _PipelinedModel:
         assert not (isinstance(self.head_spec, TiedLayerSpec) and not self.tied), (
             "TiedLayerSpec head requires a TiedLayerSpec embed with the same key")
         self.L = len(self.block_specs)
-        assert self.L % self.P == 0, (
-            f"{self.L} blocks not divisible by {self.P} pipeline stages")
-        self.Lp = self.L // self.P
         self.embed = self.embed_spec.build()
         self.block = self.block_specs[0].build()
         self.head = self.head_spec.build()
@@ -110,6 +111,35 @@ class _PipelinedModel:
         if self.tied:
             assert self._head_tied_kw, (
                 "tied head layer must accept a tied= kwarg for the shared params")
+
+    def _call_head(self, p, y, tied_params, rng, train):
+        kw = {"rng": rng, "train": train} if _takes_kw(self.head.__call__, "rng") else {}
+        if self._head_tied_kw:
+            kw["tied"] = tied_params
+        return self.head(p, y, **kw)
+
+    def _own_specs(self, layer):
+        if hasattr(layer, "partition_specs"):
+            return layer.partition_specs()
+        return jax.tree.map(lambda _: PartitionSpec(),
+                            layer.init_params(jax.random.PRNGKey(0)))
+
+    def layer_params(self, params, l: int):
+        """Block ``l``'s params out of the stacked layout (layout differs
+        per schedule; used by tests/checkpoint reshaping)."""
+        raise NotImplementedError
+
+
+class _PipelinedModel(_PipeModelBase):
+    """GPipe-as-vmap adapter (engine contract
+    ``fn(params, batch, rng, train) -> loss``); the schedule is one
+    differentiated program — see module docstring."""
+
+    def __init__(self, module: PipelineModule, mesh):
+        super().__init__(module, mesh)
+        assert self.L % self.P == 0, (
+            f"{self.L} blocks not divisible by {self.P} pipeline stages")
+        self.Lp = self.L // self.P
 
     # ---- params ------------------------------------------------------- #
     def init_params(self, rng):
@@ -131,14 +161,11 @@ class _PipelinedModel:
             return jax.tree.map(add, lspecs,
                                 is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))
 
-        def own(layer):
-            if hasattr(layer, "partition_specs"):
-                return layer.partition_specs()
-            return jax.tree.map(lambda _: PartitionSpec(),
-                                layer.init_params(jax.random.PRNGKey(0)))
+        return {"embed": self._own_specs(self.embed), "blocks": pipe_prefix(self.block),
+                "head": self._own_specs(self.head)}
 
-        return {"embed": own(self.embed), "blocks": pipe_prefix(self.block),
-                "head": own(self.head)}
+    def layer_params(self, params, l: int):
+        return jax.tree.map(lambda a: a[l], params["blocks"])
 
     # ---- pipelined loss ----------------------------------------------- #
     def _stage_constrain(self, y):
@@ -146,12 +173,6 @@ class _PipelinedModel:
         return jax.lax.with_sharding_constraint(
             y, NamedSharding(self.mesh,
                              PartitionSpec("pipe", mesh_lib.BATCH_AXES, "seq", None)))
-
-    def _call_head(self, p, y, tied_params, rng, train):
-        kw = {"rng": rng, "train": train} if _takes_kw(self.head.__call__, "rng") else {}
-        if self._head_tied_kw:
-            kw["tied"] = tied_params
-        return self.head(p, y, **kw)
 
     def __call__(self, params, batch, rng, train):
         """``batch`` leaves have leading dim M (micro-batches)."""
@@ -229,6 +250,380 @@ class _PipelinedModel:
         return loss_sum / M
 
 
+class _Pipelined1F1BModel(_PipeModelBase):
+    """1F1B pipeline with per-stage programs under ``shard_map`` manual over
+    the ``pipe`` axis (reference ``TrainSchedule``, ``pipe/schedule.py:189``,
+    and the instruction interpreter ``pipe/engine.py:1286``).
+
+    TPU-native redesign of the reference's per-stage NCCL processes:
+
+    * **One SPMD program, per-device branches.**  Under ``shard_map`` every
+      pipe-group runs the same code; ``lax.cond`` on ``axis_index('pipe')``
+      makes only the first stage run the embedding (forward AND backward).
+      The head + loss run with a gradient seed masked to the last stage —
+      semantically only the last stage's head counts, but its FLOPs execute
+      everywhere: a ``lax.cond`` around the head's vjp whose output feeds
+      the next tick's block vjp provokes a pathological (>30 min) SPMD
+      partitioner compile under TP (the same reason tick-level fill/drain
+      masking uses zero seeds, see ``tick()``).
+    * **Heterogeneous stage sizes.**  ``PipelineModule.partition()``
+      (uniform / parameters / type:regex — reference
+      ``_partition_layers:353``) assigns each stage its block count; stacked
+      stage params are padded to the max count and inactive slots are
+      masked with a select (same partitioner constraint).
+    * **1F1B memory profile.**  The schedule interleaves one forward and
+      one backward stage-step per tick; each stage saves only its INPUT
+      activation per in-flight micro-batch (circular buffer of depth 2P)
+      and recomputes the stage body inside ``jax.vjp`` during its backward
+      tick (Megatron-style full recompute).  Live activation memory is
+      ∝ stages, not ∝ micro-batches — the GPipe engine's scan holds all M.
+    * **P2P = ppermute.**  Forward activations hop ``i → i+1``, backward
+      gradients hop ``i → i-1`` on the ``pipe`` ICI ring each tick; the
+      reference's shape-metadata handshake (``pipe/p2p.py:100``) vanishes
+      because shapes are static under jit.
+    * **Tied weights.**  Embed grads accumulate from stage 0 (embedding
+      backward) and stage P-1 (tied head) and are combined with a single
+      ``psum`` over ``pipe`` — the reference's ReduceTiedGrads
+      (``pipe/engine.py:223``).
+
+    Schedule indices (tick ``t``, stage ``s``, P stages, M micros): forward
+    of micro ``f = t - s``; backward of micro ``b = t - (2P - 1 - s)``;
+    total ticks ``M + 2P - 1``.  The backward half runs first within a
+    tick (it consumes the head gradient stored by the previous tick's
+    forward).
+    """
+
+    def __init__(self, module: PipelineModule, mesh):
+        super().__init__(module, mesh)
+        P = self.P
+        # honest partition() consumption: weight layers by parameter count
+        # (embed/head included, like the reference) and intersect the
+        # resulting bounds with the block range
+        def n_params(layer):
+            shapes = jax.eval_shape(layer.init_params, jax.random.PRNGKey(0))
+            return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+
+        block_count = n_params(self.block)
+        weights = ([n_params(self.embed)] + [block_count] * self.L
+                   + [n_params(self.head)])
+        module.num_stages = P
+        parts = module.partition(weights)
+        self.counts = []
+        for s in range(P):
+            lo, hi = parts[s], parts[s + 1]
+            self.counts.append(len([i for i in range(lo, hi) if 1 <= i <= self.L]))
+        assert sum(self.counts) == self.L, (parts, self.counts)
+        self.offsets = list(np.concatenate([[0], np.cumsum(self.counts)[:-1]]))
+        self.Lmax = max(max(self.counts), 1)
+        log_dist(f"1F1B partition ({module.partition_method}): "
+                 f"blocks/stage={self.counts}", ranks=[0])
+
+    # ---- params ------------------------------------------------------- #
+    def init_params(self, rng):
+        ks = jax.random.split(rng, 3)
+        block_keys = jax.random.split(ks[1], self.L)
+        blocks = [self.block.init_params(k) for k in block_keys]
+        pad = jax.tree.map(lambda a: jnp.zeros_like(a), blocks[0])
+        stages = []
+        for s in range(self.P):
+            own = blocks[self.offsets[s]:self.offsets[s] + self.counts[s]]
+            own = own + [pad] * (self.Lmax - len(own))
+            stages.append(jax.tree.map(lambda *xs: jnp.stack(xs), *own))
+        return {
+            "embed": self.embed.init_params(ks[0]),
+            "blocks": jax.tree.map(lambda *xs: jnp.stack(xs), *stages),  # [P, Lmax, ...]
+            "head": self.head.init_params(ks[2]),
+        }
+
+    def partition_specs(self):
+        lspecs = (self.block.partition_specs() if hasattr(self.block, "partition_specs")
+                  else jax.tree.map(lambda _: None, self.block.init_params(jax.random.PRNGKey(0))))
+
+        def add(s):
+            inner = tuple(s) if s is not None else ()
+            return PartitionSpec("pipe", None, *inner)
+
+        blocks = jax.tree.map(add, lspecs,
+                              is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))
+        return {"embed": self._own_specs(self.embed), "blocks": blocks,
+                "head": self._own_specs(self.head)}
+
+    def layer_params(self, params, l: int):
+        s = next(i for i in range(self.P)
+                 if self.offsets[i] <= l < self.offsets[i] + self.counts[i])
+        return jax.tree.map(lambda a: a[s, l - self.offsets[s]], params["blocks"])
+
+    # ---- helpers ------------------------------------------------------ #
+    def _probe_act(self, params, inputs, rng):
+        one = jax.tree.map(lambda a: a[0], inputs)
+        kw = ({"rng": rng, "train": False}
+              if _takes_kw(self.embed.__call__, "rng") else {})
+        return jax.eval_shape(lambda p, i: self.embed(p, i, **kw),
+                              params["embed"], one)
+
+    def _shard_specs(self, params, batch):
+        pipe_first = jax.tree.map(
+            lambda a: PartitionSpec("pipe", *([None] * (a.ndim - 1))),
+            params["blocks"])
+        repl = lambda tree: jax.tree.map(
+            lambda a: PartitionSpec(*([None] * getattr(a, "ndim", 0))), tree)
+        return pipe_first, repl
+
+    # ---- the schedule ------------------------------------------------- #
+    def value_and_grad(self, params, batch, rng, train, scale=1.0):
+        inputs, labels = batch
+        M = jax.tree.leaves(inputs)[0].shape[0]
+        P, Lmax = self.P, self.Lmax
+        W = 2 * P
+        T = M + 2 * P - 1
+        counts = jnp.asarray(self.counts, jnp.int32)
+        offsets = jnp.asarray(self.offsets, jnp.int32)
+        block_takes_rng = _takes_kw(self.block.__call__, "rng")
+        embed_takes_rng = _takes_kw(self.embed.__call__, "rng")
+        train_rng = train and rng is not None
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        act = self._probe_act(params, inputs, rng)
+        act_shape, act_dtype = act.shape, act.dtype
+        f32 = jnp.float32
+        pipe_first, repl = self._shard_specs(params, batch)
+
+        def body(blocks_l, embed_p, head_p, inputs, labels):
+            # NOT under manual_sharding(): the shard_map is manual only over
+            # 'pipe', so the layers' activation constraints (tensor/seq/data
+            # axes — all auto here) remain legal and give XLA's sharding
+            # propagation its anchors; without them the partial-auto pass
+            # has been observed to hang compiling the TP-sharded stage body
+            return _body(blocks_l, embed_p, head_p, inputs, labels)
+
+        def _body(blocks_l, embed_p, head_p, inputs, labels):
+            # the split 'pipe' dim arrives as a leading axis of size 1
+            blocks_l = jax.tree.map(lambda a: a[0], blocks_l)
+            s = jax.lax.axis_index("pipe")
+            count_s = counts[s]
+            off_s = offsets[s]
+            is_first = s == 0
+            is_last = s == P - 1
+
+            def blocks_fwd(bp, x, micro):
+                """Stage body: this stage's (padded) block stack.  Padded
+                slots are masked with a SELECT, not lax.cond — the
+                transposed cond-in-scan defeats the SPMD partitioner under
+                TP (same pathology as the tick-level conds, see tick())."""
+                mr = jax.random.fold_in(rng, micro)
+
+                def one(x, inp):
+                    p, li = inp
+                    kw = ({"rng": jax.random.fold_in(mr, off_s + li),
+                           "train": train_rng} if block_takes_rng else {})
+                    y = self.block(p, x, **kw)
+                    return jnp.where(li < count_s, y, x), None
+
+                x, _ = jax.lax.scan(one, x, (bp, jnp.arange(Lmax)))
+                return x
+
+            def embed_fwd(ep, micro):
+                ids = jax.tree.map(lambda a: a[micro], inputs)
+                kw = ({"rng": jax.random.fold_in(jax.random.fold_in(rng, micro), 10 ** 6),
+                       "train": train_rng} if embed_takes_rng else {})
+                return self.embed(ep, ids, **kw).astype(act_dtype)
+
+            def head_loss(hp, ep, y, micro):
+                out = self._call_head(
+                    hp, y, ep, jax.random.fold_in(jax.random.fold_in(rng, micro),
+                                                  10 ** 6 + 1), train_rng)
+                lbl = jax.tree.map(lambda a: a[micro], labels)
+                return self.loss_fn(out, lbl).astype(f32)
+
+            zero_act = jnp.zeros(act_shape, act_dtype)
+            zgb = jax.tree.map(lambda a: jnp.zeros(a.shape, f32), blocks_l)
+            zge = jax.tree.map(lambda a: jnp.zeros(a.shape, f32), embed_p)
+            zgh = jax.tree.map(lambda a: jnp.zeros(a.shape, f32), head_p)
+            seed = jnp.asarray(scale / M, f32)
+
+            def tick(c, t):
+                # NO lax.cond anywhere in this schedule — two hard-won rules:
+                # (1) a tick- or stage-dependent cond around vjp'd TP-sharded
+                # code sends XLA's SPMD partitioner into a combinatorial hole
+                # (observed: >30-min compiles); (2) any reshard/collective
+                # GSPMD inserts INSIDE a branch taken by one pipe group
+                # deadlocks the others at the rendezvous (observed: "expected
+                # 8 threads, only 4 arrived" aborts).  So every stage runs
+                # every program every tick, and stage/fill/drain selection is
+                # done with ZERO COTANGENT SEEDS and selects — inactive
+                # contributions are exactly zero, and the wasted fill/drain
+                # FLOPs are the same pipeline bubble the reference's 1F1B
+                # schedule has.
+                f = t - s
+                b = t - (2 * P - 1 - s)
+                fc = jnp.clip(f, 0, M - 1)
+                bc = jnp.clip(b, 0, M - 1)
+                fwd_on = (f >= 0) & (f < M)
+                bwd_on = (b >= 0) & (b < M)
+
+                # ---- backward half (uses g_head stored by last tick's fwd)
+                g = jnp.where(is_last, c["gh_act"], c["g"])
+                g = jnp.where(bwd_on, g, jnp.zeros_like(g))
+                x_saved = jax.lax.dynamic_index_in_dim(c["save"], bc % W, 0,
+                                                       keepdims=False)
+                _, vjp = jax.vjp(lambda bp, x: blocks_fwd(bp, x, bc),
+                                 blocks_l, x_saved)
+                dbp, dx = vjp(g)
+                c["gb"] = jax.tree.map(lambda a, d: a + d.astype(f32),
+                                       c["gb"], dbp)
+
+                # embedding backward: unconditional vjp with the cotangent
+                # masked to stage 0 — zero seed ⇒ zero dep elsewhere
+                emb_seed = jnp.where(is_first, dx, jnp.zeros_like(dx))
+                _, evjp = jax.vjp(lambda ep: embed_fwd(ep, bc), embed_p)
+                (dep,) = evjp(emb_seed)
+                c["ge"] = jax.tree.map(lambda a, d: a + d.astype(f32),
+                                       c["ge"], dep)
+                dx_out = dx
+
+                # ---- forward half (drain ticks recompute micro M-1 into a
+                # scratch slot and contribute a zero-seeded head)
+                x0 = embed_fwd(embed_p, fc)
+                x_in = jnp.where(is_first, x0, c["y"])
+                slot = jnp.where(fwd_on, fc % W, W)
+                c["save"] = jax.lax.dynamic_update_index_in_dim(
+                    c["save"], x_in, slot, 0)
+                y_out = blocks_fwd(blocks_l, x_in, fc)
+
+                # head + loss + vjp, seed masked to (is_last & fwd_on); the
+                # head matmul runs on every stage (a GPipe-engine-style
+                # redundancy forced by rules (1)/(2) above) but only the
+                # last stage's gradient/loss survive
+                head_on = jnp.where(is_last & fwd_on, 1.0, 0.0).astype(f32)
+                loss, hvjp = jax.vjp(
+                    lambda hp, ep, yy: head_loss(hp, ep, yy, fc),
+                    head_p, embed_p, y_out)
+                dh, de, dy = hvjp(seed * head_on)
+                c["gh"] = jax.tree.map(lambda a, d: a + d.astype(f32),
+                                       c["gh"], dh)
+                c["ge"] = jax.tree.map(lambda a, d: a + d.astype(f32),
+                                       c["ge"], de)
+                c["loss"] = c["loss"] + loss * head_on
+                c["gh_act"] = dy.astype(act_dtype)
+
+                # ---- P2P hops on the pipe ring (reference pipe/p2p.py)
+                c["y"] = jax.lax.ppermute(
+                    y_out, "pipe", [(i, i + 1) for i in range(P - 1)])
+                c["g"] = jax.lax.ppermute(
+                    dx_out, "pipe", [(i + 1, i) for i in range(P - 1)])
+                return c, None
+
+            carry = {
+                "y": zero_act, "g": zero_act, "gh_act": zero_act,
+                # W live slots + 1 scratch slot for drain-tick writes
+                "save": jnp.zeros((W + 1,) + act_shape, act_dtype),
+                "gb": zgb, "ge": zge, "gh": zgh,
+                "loss": jnp.zeros((), f32),
+            }
+            carry, _ = jax.lax.scan(tick, carry, jnp.arange(T))
+
+            loss = jax.lax.psum(carry["loss"], "pipe") / M
+            ge = jax.tree.map(lambda a: jax.lax.psum(a, "pipe"), carry["ge"])
+            gh = jax.tree.map(lambda a: jax.lax.psum(a, "pipe"), carry["gh"])
+            # re-add the split 'pipe' dim the out_spec expects
+            gb = jax.tree.map(lambda a: a[None], carry["gb"])
+            return loss, {"embed": ge, "blocks": gb, "head": gh}
+
+        fn = jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(pipe_first, repl(params["embed"]), repl(params["head"]),
+                      jax.tree.map(lambda a: PartitionSpec(*([None] * a.ndim)), inputs),
+                      jax.tree.map(lambda a: PartitionSpec(*([None] * a.ndim)), labels)),
+            out_specs=(PartitionSpec(),
+                       {"embed": repl(params["embed"]), "blocks": pipe_first,
+                        "head": repl(params["head"])}),
+            axis_names={"pipe"}, check_vma=False)
+        return fn(params["blocks"], params["embed"], params["head"],
+                  inputs, labels)
+
+    # ---- engine contract: forward-only loss (eval path) --------------- #
+    def __call__(self, params, batch, rng, train):
+        """Forward-only pipelined loss (evaluation; training goes through
+        ``value_and_grad``).  Same stage mapping, no saves, no backward."""
+        inputs, labels = batch
+        M = jax.tree.leaves(inputs)[0].shape[0]
+        P, Lmax = self.P, self.Lmax
+        T = M + P - 1
+        counts = jnp.asarray(self.counts, jnp.int32)
+        offsets = jnp.asarray(self.offsets, jnp.int32)
+        block_takes_rng = _takes_kw(self.block.__call__, "rng")
+        embed_takes_rng = _takes_kw(self.embed.__call__, "rng")
+        train_rng = train and rng is not None
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        act = self._probe_act(params, inputs, rng)
+        act_shape, act_dtype = act.shape, act.dtype
+        pipe_first, repl = self._shard_specs(params, batch)
+
+        def body(blocks_l, embed_p, head_p, inputs, labels):
+            with mesh_lib.manual_sharding():
+                return _body(blocks_l, embed_p, head_p, inputs, labels)
+
+        def _body(blocks_l, embed_p, head_p, inputs, labels):
+            blocks_l = jax.tree.map(lambda a: a[0], blocks_l)
+            s = jax.lax.axis_index("pipe")
+            count_s, off_s = counts[s], offsets[s]
+            is_first, is_last = s == 0, s == P - 1
+            zero_act = jnp.zeros(act_shape, act_dtype)
+
+            def blocks_fwd(bp, x, micro):
+                mr = jax.random.fold_in(rng, micro)
+
+                def one(x, inp):
+                    p, li = inp
+                    kw = ({"rng": jax.random.fold_in(mr, off_s + li),
+                           "train": train_rng} if block_takes_rng else {})
+                    y = self.block(p, x, **kw)
+                    return jnp.where(li < count_s, y, x), None
+
+                return jax.lax.scan(one, x, (bp, jnp.arange(Lmax)))[0]
+
+            def tick(c, t):
+                # cond-free, like the training schedule: stage/fill/drain
+                # selection via selects and masks only (see value_and_grad's
+                # tick() for why conds are forbidden here)
+                y, loss_sum = c
+                f = t - s
+                fc = jnp.clip(f, 0, M - 1)
+                fwd_on = (f >= 0) & (f < M)
+
+                ids = jax.tree.map(lambda a: a[fc], inputs)
+                ekw = ({"rng": rng, "train": train_rng}
+                       if embed_takes_rng else {})
+                x0 = self.embed(embed_p, ids, **ekw).astype(act_dtype)
+                x_in = jnp.where(is_first, x0, y)
+                y_out = blocks_fwd(blocks_l, x_in, fc)
+
+                out = self._call_head(head_p, y_out, embed_p, rng, train_rng)
+                lbl = jax.tree.map(lambda a: a[fc], labels)
+                l = self.loss_fn(out, lbl).astype(jnp.float32)
+                l = jnp.where(is_last & fwd_on, l, jnp.zeros((), jnp.float32))
+
+                y_next = jax.lax.ppermute(
+                    y_out, "pipe", [(i, i + 1) for i in range(P - 1)])
+                return (y_next, loss_sum + l), None
+
+            (_, loss_sum), _ = jax.lax.scan(
+                tick, (zero_act, jnp.zeros((), jnp.float32)), jnp.arange(T))
+            return jax.lax.psum(loss_sum, "pipe") / M
+
+        fn = jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(pipe_first, repl(params["embed"]), repl(params["head"]),
+                      jax.tree.map(lambda a: PartitionSpec(*([None] * a.ndim)), inputs),
+                      jax.tree.map(lambda a: PartitionSpec(*([None] * a.ndim)), labels)),
+            out_specs=PartitionSpec(),
+            axis_names={"pipe"}, check_vma=False)
+        return fn(params["blocks"], params["embed"], params["head"],
+                  inputs, labels)
+
+
 class PipelineEngine(DeepSpeedEngine):
     """Training engine for ``PipelineModule`` models (reference
     ``pipe/engine.py:40``).  ``train_batch`` consumes
@@ -262,11 +657,19 @@ class PipelineEngine(DeepSpeedEngine):
 
         self.pipeline_module = model
         model.num_stages = int(mesh.shape["pipe"])
-        adapted = _PipelinedModel(model, mesh)
+        self.schedule = cfg.pipeline_config.schedule
+        if self.schedule == "1f1b":
+            adapted = _Pipelined1F1BModel(model, mesh)
+            per_stage = adapted.counts
+        else:
+            assert self.schedule == "gpipe", f"unknown pipeline schedule {self.schedule!r}"
+            adapted = _PipelinedModel(model, mesh)
+            per_stage = [adapted.Lp] * adapted.P
         self._adapted = adapted
         self._inside_train_batch = False
         super().__init__(args=args, model=adapted, mesh=mesh, config_class=cfg, **kw)
-        log_dist(f"PipelineEngine: stages={adapted.P}, blocks/stage={adapted.Lp}, "
+        log_dist(f"PipelineEngine[{self.schedule}]: stages={adapted.P}, "
+                 f"blocks/stage={per_stage}, "
                  f"micro_batches/step={self.gradient_accumulation_steps()}, "
                  f"tied_embedding={adapted.tied}", ranks=[0])
 
