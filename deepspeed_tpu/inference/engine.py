@@ -39,6 +39,14 @@ class InferenceEngine:
                  params=None, mesh=None, seed: int = 0, policy=None):
         self._config = config or DeepSpeedInferenceConfig()
         self.dtype = self._config.jnp_dtype
+        # dtype="int8" means weight-only int8 (reference quantizes injected
+        # weights when config.dtype == torch.int8, GroupQuantizer
+        # ``module_inject/replace_module.py:138``); compute stays bf16
+        self.quantize_weights = (self.dtype == jnp.int8
+                                 and self._config.quant.enabled
+                                 and self._config.quant.weight.enabled)
+        if self.dtype == jnp.int8:
+            self.dtype = jnp.bfloat16
 
         # ---- foreign-model injection (reference :180-204 → module_inject)
         # an HF torch model is converted to the fused scan decode path;
@@ -92,9 +100,27 @@ class InferenceEngine:
         params = jax.tree.map(lambda p: jnp.asarray(p, self.dtype)
                               if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
                               params)
+        if self.quantize_weights:
+            # GroupQuantizer analogue: block matmul weights → int8 payload
+            # + per-channel scales; the model dequantizes at the matmul
+            # (models/gpt.py:_wget) so decode reads half the weight bytes
+            from deepspeed_tpu.module_inject.quantization import (
+                quantize_block_params, quantize_partition_specs)
+            specs = quantize_partition_specs(specs, params)
+            params = jax.jit(quantize_block_params)(params)
+            self.param_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s or PartitionSpec()), specs,
+                is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))
+            log_dist("int8 weight quantization applied to injected blocks "
+                     "(reference GroupQuantizer analogue)", ranks=[0])
         self.params = jax.device_put(params, self.param_shardings)
         self._generate_fns: Dict[Any, Callable] = {}
         self._forward_fn = None
+        import inspect
+        self._bucketed_generate = (
+            hasattr(self.module, "generate")
+            and "prompt_len" in inspect.signature(
+                self.module.generate).parameters)
         log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, "
                  f"tp={int(self.mesh.shape['tensor'])}, "
                  f"kernel_inject={self._config.replace_with_kernel_inject}", ranks=[0])
@@ -128,15 +154,47 @@ class InferenceEngine:
 
     __call__ = forward
 
+    PROMPT_BUCKET = 64   # prompt lengths are padded up to multiples of this
+
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
                  rng=None, **kwargs):
         """Autoregressive generation (reference patched ``generate`` :588).
-        One compiled program per (batch, prompt_len, max_new_tokens)."""
+
+        Prompt lengths are BUCKETED (right-padded to a multiple of
+        ``PROMPT_BUCKET``, with the true length passed as a traced scalar):
+        a serving workload compiles one program per (batch, bucket,
+        max_new_tokens) instead of one per exact prompt length — the role
+        the reference's fixed-workspace CUDA graphs play
+        (``inference/engine.py:500-528``)."""
         input_ids = jnp.asarray(input_ids)
+        B, S = input_ids.shape
+        model = self.module
+        bucketed = self._bucketed_generate
+        if bucketed:
+            S_pad = max(self.PROMPT_BUCKET,
+                        -(-S // self.PROMPT_BUCKET) * self.PROMPT_BUCKET)
+            limit = getattr(getattr(model, "cfg", None), "n_positions", None)
+            if limit is not None and S_pad + max_new_tokens > limit:
+                # padding would overflow the cache capacity — fall back to
+                # the exact-shape program for this (rare, near-limit) call
+                bucketed = False
+        if bucketed:
+            pad = jnp.zeros((B, S_pad - S), input_ids.dtype)
+            ids = jnp.concatenate([input_ids, pad], axis=1)
+            key = ((B, S_pad), max_new_tokens, float(temperature), "bucketed")
+            if key not in self._generate_fns:
+                def gen(params, ids, plen, r):
+                    return model.generate(params, ids, max_new_tokens,
+                                          rng=r, temperature=temperature,
+                                          prompt_len=plen)
+                self._generate_fns[key] = jax.jit(gen)
+            r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
+            out = self._generate_fns[key](self.params, ids,
+                                          jnp.asarray(S, jnp.int32), r)
+            # drop the pad tail: [prompt | pad | new] -> [prompt | new]
+            return jnp.concatenate([out[:, :S], out[:, S_pad:]], axis=1)
         key = (input_ids.shape, max_new_tokens, float(temperature))
         if key not in self._generate_fns:
-            model = self.module
-
             def gen(params, ids, r):
                 return model.generate(params, ids, max_new_tokens,
                                       rng=r, temperature=temperature)

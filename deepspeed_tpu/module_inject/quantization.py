@@ -1,0 +1,88 @@
+"""Weight quantization for injected inference models.
+
+The analogue of the reference's ``GroupQuantizer``
+(``module_inject/replace_module.py:138``) + the int8 dequant decode kernels
+(``csrc/transformer/inference/csrc/dequantize.cu``): transformer block
+weights are stored as int8 payloads with per-output-channel fp scales, and
+every consumer matmul dequantizes on the fly — XLA fuses the
+``int8 → bf16 × scale`` chain into the matmul operand read, so decode (a
+memory-bound regime) reads half the HBM bytes per weight.  Triggered by
+``dtype="int8"`` on the inference config, exactly like the reference
+(``inference/engine.py`` quantizes when ``config.dtype == torch.int8``).
+
+Layout: a quantized leaf replaces the weight array with a dict
+``{"q8": int8[..., in, out], "scale": f32[..., 1, out]}`` (leading stacked
+layer dims preserved).  ``models/gpt.py:_wget`` dequantizes transparently,
+so the same model code serves fp and int8 params.
+"""
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+# block weights worth quantizing: the large 2-D matmul operands
+# (the reference's GroupQuantizer targets the same qkv/dense/mlp set)
+QUANT_KEYS = ("qkv_w", "out_w", "fc_w", "proj_w")
+
+
+def is_quantized_leaf(x) -> bool:
+    return isinstance(x, dict) and "q8" in x and "scale" in x
+
+
+def quantize_weight(w, bits: int = 8):
+    """Per-output-channel symmetric int8: scale over the penultimate
+    (input) axis.  ``w``: [..., in, out] float."""
+    assert bits == 8, "int8 weight-only quantization (int4 via ops.quantizer)"
+    wf = jnp.asarray(w, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2, keepdims=True), 1e-12) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -128, 127).astype(jnp.int8)
+    return {"q8": q, "scale": scale.astype(jnp.float32)}
+
+
+def dequantize_weight(leaf: Dict, dt):
+    return (leaf["q8"].astype(dt) * leaf["scale"].astype(dt))
+
+
+def quantize_block_params(params, keys: Sequence[str] = QUANT_KEYS,
+                          bits: int = 8):
+    """Quantize the named weight leaves anywhere in a params pytree (dict
+    keys matched by name, arbitrary nesting/stacking)."""
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                if k in keys and hasattr(v, "ndim") and v.ndim >= 2:
+                    out[k] = quantize_weight(v, bits)
+                else:
+                    out[k] = walk(v)
+            return out
+        return tree
+
+    return walk(params)
+
+
+def quantize_partition_specs(specs, params, keys: Sequence[str] = QUANT_KEYS):
+    """Transform a partition-spec tree in lockstep with
+    ``quantize_block_params``: q8 keeps the weight's spec; the [.., 1, out]
+    scale keeps only the output-channel sharding."""
+
+    def walk(stree, ptree):
+        if isinstance(ptree, dict):
+            out = {}
+            for k, v in ptree.items():
+                s = stree[k] if isinstance(stree, dict) else stree
+                if k in keys and hasattr(v, "ndim") and v.ndim >= 2:
+                    spec = s if isinstance(s, PartitionSpec) else PartitionSpec()
+                    pad = [None] * max(0, v.ndim - len(spec))
+                    full = list(spec) + pad
+                    scale_spec = PartitionSpec(*(full[:-2] + [None, full[-1]]))
+                    out[k] = {"q8": spec, "scale": scale_spec}
+                else:
+                    out[k] = walk(s, v)
+            return out
+        return stree
+
+    return walk(specs, params)
