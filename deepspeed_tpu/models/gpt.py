@@ -84,6 +84,26 @@ class GPTConfig:
     n_kv_head: Optional[int] = None
     # pad vocab to a multiple (MXU-friendly, and divisible by tensor axis)
     vocab_multiple: int = 128
+    # block topology: 'sequential' (GPT-2/OPT/LLaMA), 'parallel' (GPT-NeoX
+    # use_parallel_residual: x + attn(ln1 x) + mlp(ln2 x)), or
+    # 'parallel_single_ln' (GPT-J: one LN feeds both attn and mlp)
+    block_type: str = "sequential"
+    # rotary variants: partial rotary dims (GPT-J rotary_dim / NeoX
+    # rotary_pct) and GPT-J's interleaved (rotate-every-two) pairing
+    rope_dim: Optional[int] = None
+    rope_interleaved: bool = False
+    # untied lm_head bias (GPT-J checkpoints carry one)
+    head_bias: bool = False
+    # --- mixture-of-experts (reference deepspeed/moe): >0 replaces every
+    # block's MLP with a top-k gated expert bank sharded over the 'expert'
+    # mesh axis; the load-balance aux loss is added in gpt_loss ----------- #
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: float = 2.0
+    moe_min_capacity: int = 4
+    moe_aux_coeff: float = 0.01
+    moe_expert_hidden: Optional[int] = None
 
     def __post_init__(self):
         self.padded_vocab = int(
@@ -96,6 +116,8 @@ class GPTConfig:
         self.qkv_dim = (self.n_head + 2 * self.kv_heads) * self.head_dim
         self.ffn_dim = self.intermediate_size or 4 * self.n_embd
         assert self.position_encoding in ("learned", "rope", "alibi")
+        assert self.block_type in ("sequential", "parallel", "parallel_single_ln")
+        assert self.moe_top_k in (1, 2), "top-1 and top-2 gating supported" 
         assert self.norm in ("layernorm", "rmsnorm")
         assert self.mlp_type in ("standard", "swiglu")
 
@@ -155,7 +177,7 @@ def _init_block(cfg: GPTConfig, rng: Array) -> Dict:
     fc_out = 2 * I if cfg.mlp_type == "swiglu" else I   # swiglu fuses gate|up
     proj_scale = 0.02 / math.sqrt(2 * cfg.n_layer)
     ks = jax.random.split(rng, 4)
-    return {
+    out = {
         "ln1_g": jnp.ones((E,), jnp.float32),
         "ln1_b": jnp.zeros((E,), jnp.float32),
         "qkv_w": _dense_init(ks[0], E, (E, cfg.qkv_dim)),
@@ -169,6 +191,20 @@ def _init_block(cfg: GPTConfig, rng: Array) -> Dict:
         "proj_w": _dense_init(ks[3], I, (I, E), scale=proj_scale),
         "proj_b": jnp.zeros((E,), jnp.float32),
     }
+    if cfg.moe_num_experts > 0:
+        # the MLP becomes a gated expert bank (reference moe/layer.py:16);
+        # dense fc/proj weights are dropped from the pytree
+        from deepspeed_tpu.moe.experts import Experts, FFNExpert
+        ex = Experts(FFNExpert(E, cfg.moe_expert_hidden or I),
+                     cfg.moe_num_experts)
+        km = jax.random.split(jax.random.fold_in(rng, 1234), 2)
+        for k in ("fc_w", "fc_b", "proj_w", "proj_b"):
+            del out[k]
+        out["moe"] = {
+            "gate": {"wg": _dense_init(km[0], E, (E, cfg.moe_num_experts))},
+            "experts": ex.init_params(km[1]),
+        }
+    return out
 
 
 def _init_embed(cfg: GPTConfig, rng: Array) -> Dict:
@@ -203,6 +239,8 @@ def init_gpt_params(cfg: GPTConfig, rng: Array) -> Dict:
     if cfg.untied_head:
         params["lm_head"] = _dense_init(
             jax.random.fold_in(k_embed, 2), E, (cfg.padded_vocab, E))
+        if cfg.head_bias:
+            params["lm_head_b"] = jnp.zeros((cfg.padded_vocab,), jnp.float32)
     return params
 
 
@@ -227,7 +265,22 @@ def gpt_partition_specs(cfg: GPTConfig) -> Dict:
     """
     def block_specs(stacked: bool):
         pre = (None,) if stacked else ()
-        return {k: PartitionSpec(*pre, *s) for k, s in _BLOCK_SPECS.items()}
+        keys = dict(_BLOCK_SPECS)
+        if cfg.moe_num_experts > 0:
+            for k in ("fc_w", "fc_b", "proj_w", "proj_b"):
+                del keys[k]
+        specs = {k: PartitionSpec(*pre, *s) for k, s in keys.items()}
+        if cfg.moe_num_experts > 0:
+            specs["moe"] = {
+                "gate": {"wg": PartitionSpec(*pre)},
+                "experts": {
+                    "wi": PartitionSpec(*pre, "expert", None, "tensor"),
+                    "bi": PartitionSpec(*pre, "expert", "tensor"),
+                    "wo": PartitionSpec(*pre, "expert", "tensor", None),
+                    "bo": PartitionSpec(*pre, "expert", None),
+                },
+            }
+        return specs
 
     if cfg.scan_layers:
         blocks = block_specs(True)
@@ -243,6 +296,8 @@ def gpt_partition_specs(cfg: GPTConfig) -> Dict:
         specs["wpe"] = PartitionSpec()
     if cfg.untied_head:
         specs["lm_head"] = PartitionSpec("tensor", None)
+        if cfg.head_bias:
+            specs["lm_head_b"] = PartitionSpec("tensor")
     return specs
 
 
@@ -274,17 +329,34 @@ def _norm(cfg: "GPTConfig", x: Array, g: Array, b: Array) -> Array:
     return layer_norm(x, g, b, eps=cfg.ln_eps)
 
 
-def apply_rope(x: Array, positions: Array, theta: float = 10000.0) -> Array:
-    """Rotary position embedding on [B, S, H, D] (LLaMA-style pairing)."""
+def apply_rope(x: Array, positions: Array, theta: float = 10000.0,
+               rope_dim: Optional[int] = None,
+               interleaved: bool = False) -> Array:
+    """Rotary position embedding on [B, S, H, D].
+
+    Default: LLaMA/NeoX half-split pairing over the full head dim.
+    ``rope_dim`` rotates only the first ``rope_dim`` features (GPT-J
+    ``rotary_dim``, NeoX ``rotary_pct``); ``interleaved`` uses GPT-J's
+    rotate-every-two pairing ((0,1),(2,3),...)."""
     B, S, H, D = x.shape
-    half = D // 2
+    rd = rope_dim or D
+    xr = x[..., :rd].astype(jnp.float32)
+    half = rd // 2
     freqs = (1.0 / theta) ** (jnp.arange(half, dtype=jnp.float32) / half)
     angles = positions[:, None].astype(jnp.float32) * freqs[None]   # [S, half]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
-    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.astype(x.dtype)
+    if interleaved:
+        x1, x2 = xr[..., 0::2], xr[..., 1::2]
+        rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                        axis=-1).reshape(xr.shape)
+    else:
+        x1, x2 = xr[..., :half], xr[..., half:]
+        rot = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                              axis=-1)
+    if rd == D:
+        return rot.astype(x.dtype)
+    return jnp.concatenate([rot.astype(x.dtype), x[..., rd:]], axis=-1)
 
 
 def _split_qkv(cfg: "GPTConfig", qkv: Array):
@@ -300,9 +372,11 @@ def _wget(p: Dict, key: str, dt) -> Array:
     """Weight fetch that transparently dequantizes int8-injected params
     (``module_inject/quantization.py``; reference GroupQuantizer +
     ``dequantize.cu``) — same model code serves fp and int8 weights."""
+    from deepspeed_tpu.module_inject.quantization import (dequantize_weight,
+                                                          is_quantized_leaf)
     w = p[key]
-    if isinstance(w, dict) and "q8" in w:
-        return w["q8"].astype(dt) * w["scale"].astype(dt)
+    if is_quantized_leaf(w):
+        return dequantize_weight(w, dt)
     return w.astype(dt)
 
 
@@ -319,6 +393,35 @@ def _mlp(cfg: "GPTConfig", p: Dict, h: Array, dt) -> Array:
     if cfg.use_bias:
         out = out + p["proj_b"].astype(dt)
     return out
+
+
+def _ffn(cfg: "GPTConfig", p: Dict, h: Array, dt, rng=None,
+         train: bool = False) -> Tuple[Array, Array]:
+    """Dense MLP or top-k gated MoE expert bank (reference ``moe/layer.py:16``
+    when ``moe_num_experts > 0``).  Returns ``(y, aux_loss)``; the aux loss
+    is zero on the dense path."""
+    if cfg.moe_num_experts == 0:
+        return _mlp(cfg, p, h, dt), jnp.zeros((), jnp.float32)
+    from deepspeed_tpu.moe.experts import FFNExpert
+    from deepspeed_tpu.moe.sharded_moe import (moe_dispatch_combine,
+                                               top1gating, top2gating)
+    E = cfg.n_embd
+    lead = h.shape[:-1]
+    xt = h.reshape(-1, E)
+    logits = xt.astype(jnp.float32) @ p["moe"]["gate"]["wg"].astype(jnp.float32)
+    cf = cfg.moe_capacity_factor if train else cfg.moe_eval_capacity_factor
+    if cfg.moe_top_k == 1:
+        l_aux, combine, dispatch, _ = top1gating(
+            logits, capacity_factor=cf, min_capacity=cfg.moe_min_capacity,
+            noise_rng=rng if train else None)
+    else:
+        l_aux, combine, dispatch, _ = top2gating(
+            logits, capacity_factor=cf, min_capacity=cfg.moe_min_capacity,
+            noise_rng=rng if train else None)
+    expert = FFNExpert(E, cfg.moe_expert_hidden or cfg.ffn_dim)
+    y = moe_dispatch_combine(xt, combine, dispatch, expert,
+                             p["moe"]["experts"])
+    return y.reshape(*lead, E).astype(dt), l_aux.astype(jnp.float32)
 
 
 def layer_norm(x: Array, g: Array, b: Array, eps: float = 1e-5) -> Array:
@@ -338,8 +441,9 @@ def _dropout(x: Array, rate: float, rng: Optional[Array], train: bool) -> Array:
 
 
 def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
-              train: bool, attention_fn: Callable) -> Array:
-    """One transformer block on ``x: [batch, seq, embd]``."""
+              train: bool, attention_fn: Callable) -> Tuple[Array, Array]:
+    """One transformer block on ``x: [batch, seq, embd]``.  Returns
+    ``(x, moe_aux)``; the aux term is zero for dense blocks."""
     B, S, E = x.shape
     H, D = cfg.n_head, cfg.head_dim
     dt = x.dtype
@@ -353,8 +457,8 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
         q, k, v = _split_qkv(cfg, qkv)
         if cfg.position_encoding == "rope":
             pos = jnp.arange(S)
-            q = apply_rope(q, pos, cfg.rope_theta)
-            k = apply_rope(k, pos, cfg.rope_theta)
+            q = apply_rope(q, pos, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+            k = apply_rope(k, pos, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
         # grouped K/V go to the attention op as-is: the Pallas kernel (and
         # the GQA-aware jnp reference) consume Hkv < H heads natively, so
         # training saves the K/V-expansion HBM the round-3 path paid here
@@ -374,21 +478,31 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
         o = o @ _wget(p, "out_w", dt)
         if cfg.use_bias:
             o = o + p["out_b"].astype(dt)
-        x = x + _dropout(o, cfg.dropout, r[0], train)
-        x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+        o = _dropout(o, cfg.dropout, r[0], train)
 
     with jax.named_scope("mlp"):
-        h = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
-        h = _mlp(cfg, p, h, dt)
-        x = x + _dropout(h, cfg.dropout, r[1], train)
-    return _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+        if cfg.block_type == "sequential":
+            x = _constrain(x + o, mesh_lib.BATCH_AXES, "seq", None)
+            h2 = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+            f, moe_aux = _ffn(cfg, p, h2, dt, rng=r[1], train=train)
+            x = x + _dropout(f, cfg.dropout, r[2], train)
+        elif cfg.block_type == "parallel":
+            # GPT-NeoX use_parallel_residual: x + attn(ln1 x) + mlp(ln2 x)
+            h2 = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+            f, moe_aux = _ffn(cfg, p, h2, dt, rng=r[1], train=train)
+            x = x + o + _dropout(f, cfg.dropout, r[2], train)
+        else:   # parallel_single_ln (GPT-J): one LN feeds attn AND mlp
+            f, moe_aux = _ffn(cfg, p, h, dt, rng=r[1], train=train)
+            x = x + o + _dropout(f, cfg.dropout, r[2], train)
+    return _constrain(x, mesh_lib.BATCH_AXES, "seq", None), moe_aux
 
 
 def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
                 rng: Optional[Array] = None, train: bool = False,
                 attention_fn: Optional[Callable] = None,
                 pld_theta: Optional[Array] = None,
-                return_hidden: bool = False) -> Array:
+                return_hidden: bool = False,
+                with_aux: bool = False) -> Array:
     """Logits ``[batch, seq, padded_vocab]`` (bf16 compute, fp32 logits).
 
     ``pld_theta`` enables progressive layer drop (reference
@@ -444,12 +558,15 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
         keep_p = 1.0 - depth_frac * (1.0 - pld_theta)
         pld_keep = jax.random.bernoulli(jax.random.fold_in(rng, 55), keep_p)
 
+    zero_aux = jnp.zeros((), jnp.float32)
+
     def apply_block(p, x, r, idx=None, ltd_this_layer=True):
         if ltd_on and idx is not None and ltd_this_layer:
-            sub = body(p, jnp.take(x, idx, axis=1), r)
-            return x.at[:, idx].set(sub)
+            sub, aux = body(p, jnp.take(x, idx, axis=1), r)
+            return x.at[:, idx].set(sub), aux
         return body(p, x, r)
 
+    aux_total = zero_aux
     if cfg.scan_layers:
         use_rngs = rng is not None and train
         rngs = (jax.random.split(jax.random.fold_in(rng, 7), cfg.n_layer)
@@ -460,15 +577,19 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
         if pld_on:
             xs["keep"] = pld_keep
 
-        def scan_body(x, layer):
+        def scan_body(carry, layer):
+            x, aux_sum = carry
             r = layer["r"] if use_rngs else None
             run = lambda xx: apply_block(layer["p"], xx, r, layer.get("idx"))
             if pld_on:   # lax.cond: a dropped block really skips its FLOPs
-                return jax.lax.cond(layer["keep"], run, lambda xx: xx, x), None
-            return run(x), None
+                x, aux = jax.lax.cond(layer["keep"], run,
+                                      lambda xx: (xx, zero_aux), x)
+            else:
+                x, aux = run(x)
+            return (x, aux_sum + aux), None
 
         with jax.named_scope("blocks"):
-            x, _ = jax.lax.scan(scan_body, x, xs)
+            (x, aux_total), _ = jax.lax.scan(scan_body, (x, zero_aux), xs)
     else:
         for i in range(cfg.n_layer):
             r = jax.random.fold_in(rng, i) if (rng is not None and train) else None
@@ -477,23 +598,29 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
             run = lambda xx: apply_block(p, xx, r, ltd_idx[i] if ltd_on else None,
                                          ltd_this)
             if pld_on:
-                x = jax.lax.cond(pld_keep[i], run, lambda xx: xx, x)
+                x, aux = jax.lax.cond(pld_keep[i], run,
+                                      lambda xx: (xx, zero_aux), x)
             else:
-                x = run(x)
+                x, aux = run(x)
+            aux_total = aux_total + aux
 
     with jax.named_scope("head"):
         x = _norm(cfg, x, params["lnf_g"], params["lnf_b"])
         if return_hidden:   # training loss path: chunked CE owns the head
-            return x
+            return (x, aux_total) if with_aux else x
         # tied embedding projection (or the untied lm_head when the source
         # checkpoint has one); vocab-parallel → logits sharded over tensor
         head = params["lm_head"] if cfg.untied_head else params["wte"]
         logits = (x @ head.astype(dt).T).astype(jnp.float32)
-    return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
+        if cfg.head_bias:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
+    logits = _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
+    return (logits, aux_total) if with_aux else logits
 
 
 def chunked_cross_entropy(x: Array, head: Array, labels: Array,
-                          vocab_size: int, n_chunks: int = 0) -> Array:
+                          vocab_size: int, n_chunks: int = 0,
+                          head_b: Optional[Array] = None) -> Array:
     """Cross-entropy over the unembedding WITHOUT materializing [N, V]
     logits: rows are processed in chunks under ``jax.checkpoint``, so both
     forward and backward hold one [chunk, V] logits block at a time (the
@@ -524,6 +651,8 @@ def chunked_cross_entropy(x: Array, head: Array, labels: Array,
     rows = N // n_chunks
     if n_chunks == 1:
         logits = (x.reshape(N, E) @ head.astype(x.dtype).T).astype(jnp.float32)
+        if head_b is not None:
+            logits = logits + head_b.astype(jnp.float32)
         if V != vocab_size:
             logits = jnp.where(jnp.arange(V)[None] < vocab_size, logits, -1e9)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -537,6 +666,8 @@ def chunked_cross_entropy(x: Array, head: Array, labels: Array,
     def chunk(total, xs):
         xch, lch = xs
         logits = (xch @ head.astype(xch.dtype).T).astype(jnp.float32)  # [rows, V]
+        if head_b is not None:
+            logits = logits + head_b.astype(jnp.float32)
         if mask_pad:
             logits = jnp.where(jnp.arange(V)[None] < vocab_size, logits, -1e9)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -560,10 +691,17 @@ def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
              pld_theta: Optional[Array] = None) -> Array:
     """Next-token cross-entropy, masking padded vocab entries.  Computed
     chunked over the head projection (no [B, S, V] logits tensor exists)."""
-    x = gpt_forward(cfg, params, input_ids, rng, train, attention_fn,
-                    pld_theta=pld_theta, return_hidden=True)
+    x, aux = gpt_forward(cfg, params, input_ids, rng, train, attention_fn,
+                         pld_theta=pld_theta, return_hidden=True,
+                         with_aux=True)
     head = params["lm_head"] if cfg.untied_head else params["wte"]
-    return chunked_cross_entropy(x, head, labels, cfg.vocab_size)
+    ce = chunked_cross_entropy(x, head, labels, cfg.vocab_size,
+                               head_b=params.get("lm_head_b")
+                               if cfg.head_bias else None)
+    if cfg.moe_num_experts > 0:
+        # load-balance aux loss (reference l_aux, sharded_moe.py:179)
+        ce = ce + cfg.moe_aux_coeff * aux
+    return ce
 
 
 # --------------------------------------------------------------------------- #
@@ -653,8 +791,8 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
         q, k, v = _split_qkv(cfg, qkv)
         if cfg.position_encoding == "rope":
             rpos = pos + jnp.arange(S)
-            q = apply_rope(q, rpos, cfg.rope_theta)
-            k = apply_rope(k, rpos, cfg.rope_theta)
+            q = apply_rope(q, rpos, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+            k = apply_rope(k, rpos, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
         # the cache stores only kv_heads heads (the GQA memory win);
         # expansion to n_head happens at attention time
         zero = jnp.zeros((), jnp.int32)
@@ -668,10 +806,19 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
         o = o @ _wget(p, "out_w", dt)
         if cfg.use_bias:
             o = o + p["out_b"].astype(dt)
-        x = x + o
-        h = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
-        h = _mlp(cfg, p, h, dt)
-        return (x + h, ck_full, cv_full, li + 1), None
+        if cfg.block_type == "sequential":
+            x = x + o
+            h2 = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+            f, _ = _ffn(cfg, p, h2, dt, train=False)
+            x = x + f
+        elif cfg.block_type == "parallel":
+            h2 = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+            f, _ = _ffn(cfg, p, h2, dt, train=False)
+            x = x + o + f
+        else:   # parallel_single_ln
+            f, _ = _ffn(cfg, p, h, dt, train=False)
+            x = x + o + f
+        return (x, ck_full, cv_full, li + 1), None
 
     (x, new_k, new_v, _), _ = jax.lax.scan(
         layer, (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
@@ -679,6 +826,8 @@ def gpt_apply_with_cache(cfg: GPTConfig, params: Dict, input_ids: Array,
     x = _norm(cfg, x, params["lnf_g"], params["lnf_b"])
     head = params["lm_head"] if cfg.untied_head else params["wte"]
     logits = (x @ head.astype(dt).T).astype(jnp.float32)
+    if cfg.head_bias:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "pos": pos + S}
     return logits, new_cache
 
@@ -769,8 +918,12 @@ class GPTBlockLayer:
 
     def __call__(self, p, x, rng=None, train=False):
         from deepspeed_tpu.ops.attention import get_attention_fn
-        return gpt_block(self.cfg, p, x, rng=rng, train=train,
+        assert self.cfg.moe_num_experts == 0, (
+            "MoE blocks in the pipeline engine are not supported yet — "
+            "use the scan (non-pipeline) model for MoE training")
+        x, _ = gpt_block(self.cfg, p, x, rng=rng, train=train,
                          attention_fn=get_attention_fn(self.cfg.attn_impl))
+        return x
 
 
 class GPTHeadLayer:

@@ -392,9 +392,128 @@ class HFLlamaPolicy(InjectionPolicy):
         return cfg, params
 
 
+class HFGPTJPolicy(InjectionPolicy):
+    """HF GPT-J (reference ``module_inject/containers/gptj.py``): partial
+    INTERLEAVED rotary (rotate-every-two over ``rotary_dim`` features),
+    parallel residual with a SINGLE LayerNorm feeding both attention and
+    MLP, bias-free attention projections, untied biased lm_head."""
+
+    model_types = ("gptj",)
+
+    def build(self, hf_model):
+        hc = hf_model.config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        E = hc.n_embd
+        cfg = GPTConfig(vocab_size=hc.vocab_size, n_positions=hc.n_positions,
+                        n_embd=E, n_layer=hc.n_layer, n_head=hc.n_head,
+                        position_encoding="rope",
+                        rope_dim=hc.rotary_dim, rope_interleaved=True,
+                        block_type="parallel_single_ln",
+                        activation=_map_activation(hc.activation_function),
+                        ln_eps=hc.layer_norm_epsilon,
+                        untied_head=True, head_bias=True)
+        blocks = []
+        for i in range(cfg.n_layer):
+            b = f"transformer.h.{i}."
+            qkv_w = np.concatenate(
+                [sd[b + f"attn.{n}_proj.weight"].T for n in ("q", "k", "v")],
+                axis=1)
+            blocks.append({
+                "ln1_g": sd[b + "ln_1.weight"], "ln1_b": sd[b + "ln_1.bias"],
+                "qkv_w": qkv_w,
+                "qkv_b": np.zeros((3 * E,), np.float32),
+                "out_w": sd[b + "attn.out_proj.weight"].T,
+                "out_b": np.zeros((E,), np.float32),
+                # GPT-J has no second LN: identity placeholders (the
+                # parallel_single_ln block never reads them)
+                "ln2_g": np.ones((E,), np.float32),
+                "ln2_b": np.zeros((E,), np.float32),
+                "fc_w": sd[b + "mlp.fc_in.weight"].T,
+                "fc_b": sd[b + "mlp.fc_in.bias"],
+                "proj_w": sd[b + "mlp.fc_out.weight"].T,
+                "proj_b": sd[b + "mlp.fc_out.bias"],
+            })
+        head_b = np.zeros((cfg.padded_vocab,), np.float32)
+        head_b[:hc.vocab_size] = sd["lm_head.bias"]
+        params = {
+            "wte": _pad_vocab(sd["transformer.wte.weight"], cfg.padded_vocab),
+            "blocks": _stack(blocks),
+            "lnf_g": sd["transformer.ln_f.weight"],
+            "lnf_b": sd["transformer.ln_f.bias"],
+            "lm_head": _pad_vocab(sd["lm_head.weight"], cfg.padded_vocab),
+            "lm_head_b": head_b,
+        }
+        return cfg, params
+
+
+class HFGPTNeoXPolicy(InjectionPolicy):
+    """HF GPT-NeoX / Pythia (reference ``module_inject/containers/gptneox.py``):
+    fused qkv stored HEAD-INTERLEAVED ([nh, 3, hd] rows — de-interleaved
+    here), partial half-split rotary (``rotary_pct``), parallel residual
+    when ``use_parallel_residual`` (the default)."""
+
+    model_types = ("gpt_neox",)
+
+    def build(self, hf_model):
+        hc = hf_model.config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        E = hc.hidden_size
+        nh = hc.num_attention_heads
+        hd = E // nh
+        cfg = GPTConfig(vocab_size=hc.vocab_size,
+                        n_positions=hc.max_position_embeddings,
+                        n_embd=E, n_layer=hc.num_hidden_layers, n_head=nh,
+                        position_encoding="rope",
+                        rope_dim=int(hd * hc.rotary_pct),
+                        rope_theta=getattr(hc, "rotary_emb_base", 10000.0),
+                        block_type=("parallel" if hc.use_parallel_residual
+                                    else "sequential"),
+                        activation=_map_activation(hc.hidden_act),
+                        ln_eps=hc.layer_norm_eps,
+                        intermediate_size=hc.intermediate_size,
+                        untied_head=True)
+
+        def deinterleave(w, b):
+            # rows are [nh, 3, hd]; ours want [q(nh*hd) | k | v] columns
+            w = w.reshape(nh, 3, hd, E)       # [nh, 3, hd, E]
+            b = b.reshape(nh, 3, hd)
+            qkv_w = np.concatenate(
+                [w[:, j].reshape(nh * hd, E).T for j in range(3)], axis=1)
+            qkv_b = np.concatenate([b[:, j].reshape(nh * hd) for j in range(3)])
+            return qkv_w, qkv_b
+
+        blocks = []
+        for i in range(cfg.n_layer):
+            b = f"gpt_neox.layers.{i}."
+            qkv_w, qkv_b = deinterleave(sd[b + "attention.query_key_value.weight"],
+                                        sd[b + "attention.query_key_value.bias"])
+            blocks.append({
+                "ln1_g": sd[b + "input_layernorm.weight"],
+                "ln1_b": sd[b + "input_layernorm.bias"],
+                "qkv_w": qkv_w, "qkv_b": qkv_b,
+                "out_w": sd[b + "attention.dense.weight"].T,
+                "out_b": sd[b + "attention.dense.bias"],
+                "ln2_g": sd[b + "post_attention_layernorm.weight"],
+                "ln2_b": sd[b + "post_attention_layernorm.bias"],
+                "fc_w": sd[b + "mlp.dense_h_to_4h.weight"].T,
+                "fc_b": sd[b + "mlp.dense_h_to_4h.bias"],
+                "proj_w": sd[b + "mlp.dense_4h_to_h.weight"].T,
+                "proj_b": sd[b + "mlp.dense_4h_to_h.bias"],
+            })
+        params = {
+            "wte": _pad_vocab(sd["gpt_neox.embed_in.weight"], cfg.padded_vocab),
+            "blocks": _stack(blocks),
+            "lnf_g": sd["gpt_neox.final_layer_norm.weight"],
+            "lnf_b": sd["gpt_neox.final_layer_norm.bias"],
+            "lm_head": _pad_vocab(sd["embed_out.weight"], cfg.padded_vocab),
+        }
+        return cfg, params
+
+
 def _with(cfg, **kw):
     import dataclasses
     return dataclasses.replace(cfg, **kw)
 
 
-_POLICIES = _POLICIES + (HFBloomPolicy, HFLlamaPolicy)
+_POLICIES = _POLICIES + (HFBloomPolicy, HFLlamaPolicy, HFGPTJPolicy,
+                         HFGPTNeoXPolicy)

@@ -293,3 +293,75 @@ class TestLlamaGQA:
                                        atol=2e-4, rtol=2e-4)
         finally:
             mesh_lib.reset_mesh()
+
+
+@pytest.fixture(scope="module")
+def tiny_gptj():
+    torch.manual_seed(1)
+    cfg = transformers.GPTJConfig(vocab_size=97, n_positions=64, n_embd=32,
+                                  n_layer=2, n_head=4, rotary_dim=4,
+                                  tie_word_embeddings=False)
+    return transformers.GPTJForCausalLM(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def tiny_gptneox():
+    torch.manual_seed(2)
+    cfg = transformers.GPTNeoXConfig(vocab_size=97, max_position_embeddings=64,
+                                     hidden_size=32, num_hidden_layers=2,
+                                     num_attention_heads=4, intermediate_size=128,
+                                     rotary_pct=0.5, use_parallel_residual=True)
+    return transformers.GPTNeoXForCausalLM(cfg).eval()
+
+
+class TestGPTJInjection:
+    """GPT-J: interleaved partial rotary + single-LN parallel residual +
+    biased untied head (reference module_inject/containers/gptj.py)."""
+
+    def test_logits_parity(self, tiny_gptj, ids):
+        engine = deepspeed_tpu.init_inference(tiny_gptj, dtype="float32")
+        ours = np.asarray(engine(ids))[:, :, :97]
+        ref = _hf_logits(tiny_gptj, ids)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+    def test_greedy_parity(self, tiny_gptj, ids):
+        engine = deepspeed_tpu.init_inference(tiny_gptj, dtype="float32")
+        ours = np.asarray(engine.generate(ids[:1], max_new_tokens=6))
+        ref = _hf_greedy(tiny_gptj, ids[:1], 6)
+        np.testing.assert_array_equal(ours, ref)
+
+    def test_logits_parity_tp2(self, tiny_gptj, ids):
+        engine = deepspeed_tpu.init_inference(
+            tiny_gptj, dtype="float32", tensor_parallel={"tp_size": 2})
+        ours = np.asarray(engine(ids))[:, :, :97]
+        ref = _hf_logits(tiny_gptj, ids)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
+class TestGPTNeoXInjection:
+    """GPT-NeoX/Pythia: head-interleaved fused qkv + partial rotary +
+    parallel residual (reference module_inject/containers/gptneox.py)."""
+
+    def test_logits_parity(self, tiny_gptneox, ids):
+        engine = deepspeed_tpu.init_inference(tiny_gptneox, dtype="float32")
+        ours = np.asarray(engine(ids))[:, :, :97]
+        ref = _hf_logits(tiny_gptneox, ids)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+    def test_greedy_parity(self, tiny_gptneox, ids):
+        engine = deepspeed_tpu.init_inference(tiny_gptneox, dtype="float32")
+        ours = np.asarray(engine.generate(ids[:1], max_new_tokens=6))
+        ref = _hf_greedy(tiny_gptneox, ids[:1], 6)
+        np.testing.assert_array_equal(ours, ref)
+
+    def test_sequential_variant(self, ids):
+        torch.manual_seed(3)
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=97, max_position_embeddings=64, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=4, intermediate_size=128,
+            rotary_pct=0.25, use_parallel_residual=False)
+        model = transformers.GPTNeoXForCausalLM(cfg).eval()
+        engine = deepspeed_tpu.init_inference(model, dtype="float32")
+        ours = np.asarray(engine(ids))[:, :, :97]
+        ref = _hf_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)

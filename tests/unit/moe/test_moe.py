@@ -83,7 +83,7 @@ def test_moe_trains_expert_parallel():
             return ce + 0.01 * l_aux
 
     engine, _, _, _ = deepspeed_tpu.initialize(model=MoEModel(), config={
-        "train_micro_batch_size_per_gpu": 4,
+        "train_micro_batch_size_per_gpu": 8,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "adam", "params": {"lr": 3e-3}},
         "zero_optimization": {"stage": 0},
@@ -95,3 +95,83 @@ def test_moe_trains_expert_parallel():
     # expert bank actually sharded over the expert axis
     wi = engine.state.params["moe"]["experts"]["wi"]
     assert "expert" in str(wi.sharding.spec)
+
+
+# --------------------------------------------------------------------------- #
+# Round 4: MoE end-to-end in the GPT family + expert-parallel inference
+# (verdict item 5: reference ops/transformer/inference/moe_inference.py and
+# the EP group setup in inference/engine.py:274)
+# --------------------------------------------------------------------------- #
+def _moe_gpt_cfg(**kw):
+    from deepspeed_tpu.models.gpt import gpt_config
+    base = dict(attn_impl="reference", n_layer=2, n_embd=64, n_head=2,
+                vocab_size=256, n_positions=64, dtype=jnp.float32,
+                moe_num_experts=4, moe_top_k=1)
+    base.update(kw)
+    return gpt_config("tiny", **base)
+
+
+def test_moe_gpt_trains_expert_parallel():
+    """A MoE-GPT trains through the public API on an expert-parallel mesh;
+    the load-balance aux loss is part of the objective."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT
+    from deepspeed_tpu.parallel.mesh import MeshSpec
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    mesh = MeshSpec(data=2, expert=4, device_count=8).build(jax.devices()[:8])
+    cfg = _moe_gpt_cfg()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT(cfg), config={
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 0},
+    }, mesh=mesh)
+    # expert bank leaves exist and are expert-sharded
+    wi = engine.state.params["blocks"]["moe"]["experts"]["wi"]
+    assert wi.shape[1] == 4, wi.shape          # [L, E_experts, M, H]
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8, 32), 0, cfg.vocab_size)
+    losses = [float(engine.train_batch(batch=(ids, ids))) for _ in range(6)]
+    assert losses[-1] < losses[0] * 0.95, losses
+    mesh_lib.reset_mesh()
+
+
+def test_moe_decode_matches_forward():
+    """KV-cache decode through MoE blocks (eval-capacity gating) matches the
+    full forward — a trained MoE model is servable."""
+    from deepspeed_tpu.models.gpt import (GPT, gpt_forward,
+                                          gpt_apply_with_cache, init_kv_cache)
+    cfg = _moe_gpt_cfg()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    full = gpt_forward(cfg, params, ids, train=False)
+    cached, cache = gpt_apply_with_cache(cfg, params, ids,
+                                         init_kv_cache(cfg, 2, 24))
+    np.testing.assert_allclose(np.asarray(full), np.asarray(cached),
+                               atol=2e-4, rtol=2e-4)
+    assert int(cache["pos"]) == 16
+
+
+def test_moe_init_inference_serves():
+    """init_inference serves a MoE model end-to-end (generate + logits) on
+    an expert-parallel mesh."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT
+    from deepspeed_tpu.parallel.mesh import MeshSpec
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    mesh_lib.reset_mesh()
+    mesh = MeshSpec(data=2, expert=2, tensor=2, device_count=8).build(
+        jax.devices()[:8])
+    mesh_lib.set_mesh(mesh, MeshSpec(data=2, expert=2, tensor=2, device_count=8))
+    cfg = _moe_gpt_cfg()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(model=model, params=params,
+                                          config={"dtype": "float32"})
+    ids = jnp.asarray([[5, 7, 11]], jnp.int32)
+    out = engine.generate(ids, max_new_tokens=5)
+    assert out.shape == (1, 8)
+    logits = engine(ids)
+    assert logits.shape == (1, 3, cfg.padded_vocab)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+    mesh_lib.reset_mesh()
