@@ -65,13 +65,17 @@ def run_bench(ops, sizes_mb, trials, devices=None):
                     g = jax.lax.all_gather(v, "x")        # [n, ...]
                     return g[jax.lax.axis_index("x")]
                 if op == "reducescatter":
-                    # scatter over the flattened payload (its length is a
-                    # multiple of n by construction of `lanes`), then tile
-                    # back so the chain's shapes stay fixed
+                    # scatter over the flattened payload, zero-padded to a
+                    # multiple of n, then tile back so the chain's shapes
+                    # stay fixed
                     flat = v.reshape(-1)
+                    pad = (-flat.shape[0]) % n
+                    if pad:
+                        flat = jnp.concatenate(
+                            [flat, jnp.zeros((pad,), flat.dtype)])
                     s = jax.lax.psum_scatter(flat, "x", scatter_dimension=0,
                                              tiled=True)
-                    return jnp.tile(s, n).reshape(v.shape) / n
+                    return jnp.tile(s, n)[: v.size].reshape(v.shape) / n
                 if op == "alltoall":
                     r = v.reshape(n, -1, v.shape[-1])
                     r = jax.lax.all_to_all(r, "x", split_axis=0,

@@ -136,21 +136,36 @@ class InferenceEngine:
         return self
 
     # ------------------------------------------------------------------ #
-    def forward(self, input_ids, *args, **kwargs):
-        """Full-sequence logits (one jitted program per input shape)."""
+    def forward(self, input_ids, *args, attention_mask=None, **kwargs):
+        """Full-sequence logits (one jitted program per input shape).
+        ``attention_mask`` [B, S] is honored when the model's
+        ``forward_logits`` accepts it (encoder serving with padded
+        batches)."""
         input_ids = jnp.asarray(input_ids)
+        model = self.module
+        import inspect
+        takes_mask = (hasattr(model, "forward_logits") and "attention_mask"
+                      in inspect.signature(model.forward_logits).parameters)
+        if attention_mask is not None and not takes_mask:
+            raise ValueError("this model's forward path does not accept "
+                             "attention_mask")
         if self._forward_fn is None:
-            model = self.module
 
-            def fwd(params, ids):
+            def fwd(params, ids, mask):
                 if hasattr(model, "forward_logits"):
+                    if takes_mask:
+                        return model.forward_logits(params, ids,
+                                                    attention_mask=mask)
                     return model.forward_logits(params, ids)
                 logits, _ = model.apply_with_cache(
                     params, ids, model.init_cache(ids.shape[0], ids.shape[1]))
                 return logits
 
-            self._forward_fn = jax.jit(fwd)
-        return self._forward_fn(self.params, input_ids)
+            self._forward_fn = jax.jit(fwd, static_argnums=()) if takes_mask \
+                else jax.jit(lambda p, i, m: fwd(p, i, None))
+        mask = (jnp.asarray(attention_mask) if attention_mask is not None
+                else jnp.ones_like(input_ids))
+        return self._forward_fn(self.params, input_ids, mask)
 
     __call__ = forward
 

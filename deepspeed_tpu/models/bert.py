@@ -48,6 +48,7 @@ class BertConfig:
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
     ln_eps: float = 1e-12
+    activation: str = "gelu"     # HF hidden_act (exact gelu for stock BERT)
     vocab_multiple: int = 128
 
     def __post_init__(self):
@@ -110,11 +111,13 @@ def init_bert_params(cfg: BertConfig, rng: Array) -> Dict:
         "ln_emb_g": jnp.ones((E,), jnp.float32),
         "ln_emb_b": jnp.zeros((E,), jnp.float32),
         "blocks": blocks,
-        # MLM transform head (dense + LN; decoder tied to wte)
+        # MLM transform head (dense + LN; decoder tied to wte + per-vocab
+        # bias, the HF cls.predictions.bias)
         "mlm_w": _dense_init(ks[4], E, (E, E)),
         "mlm_b": jnp.zeros((E,), jnp.float32),
         "ln_mlm_g": jnp.ones((E,), jnp.float32),
         "ln_mlm_b": jnp.zeros((E,), jnp.float32),
+        "mlm_decoder_b": jnp.zeros((cfg.padded_vocab,), jnp.float32),
     }
 
 
@@ -143,13 +146,14 @@ def bert_partition_specs(cfg: BertConfig) -> Dict:
         "blocks": blocks,
         "mlm_w": PartitionSpec(), "mlm_b": PartitionSpec(),
         "ln_mlm_g": PartitionSpec(), "ln_mlm_b": PartitionSpec(),
+        "mlm_decoder_b": PartitionSpec("tensor"),
     }
 
 
 # --------------------------------------------------------------------------- #
 def bert_block(cfg: BertConfig, p: Dict, x: Array,
                attention_fn: Callable, rng: Optional[Array] = None,
-               train: bool = False) -> Array:
+               train: bool = False, attn_bias: Optional[Array] = None) -> Array:
     """Post-LN (or pre-LN) bidirectional encoder block."""
     B, S, E = x.shape
     H, D = cfg.num_attention_heads, cfg.head_dim
@@ -163,12 +167,12 @@ def bert_block(cfg: BertConfig, p: Dict, x: Array,
         q = _constrain(q.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
         k = _constrain(k.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
         v = _constrain(v.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
-        o = attention_fn(q, k, v, causal=False).reshape(B, S, E)
+        o = attention_fn(q, k, v, causal=False, bias=attn_bias).reshape(B, S, E)
         return o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
 
     def mlp(h):
         h = h @ p["fc_w"].astype(dt) + p["fc_b"].astype(dt)
-        h = _activation(h, "gelu")
+        h = _activation(h, cfg.activation)
         return h @ p["proj_w"].astype(dt) + p["proj_b"].astype(dt)
 
     if cfg.pre_ln:
@@ -183,8 +187,11 @@ def bert_block(cfg: BertConfig, p: Dict, x: Array,
 def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
                 token_type_ids: Optional[Array] = None,
                 attention_fn: Optional[Callable] = None,
-                rng: Optional[Array] = None, train: bool = False) -> Array:
-    """Hidden states [B, S, E]."""
+                rng: Optional[Array] = None, train: bool = False,
+                attention_mask: Optional[Array] = None) -> Array:
+    """Hidden states [B, S, E].  ``attention_mask`` [B, S] (1 = real,
+    0 = pad, the HF serving convention) becomes an additive key bias so
+    pad tokens never receive attention."""
     from deepspeed_tpu.ops.attention import get_attention_fn
     attention_fn = attention_fn or get_attention_fn(cfg.attn_impl)
     B, S = input_ids.shape
@@ -200,7 +207,12 @@ def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
         x = _dropout(x, cfg.hidden_dropout_prob, rng, train)
         x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
 
-    body = partial(bert_block, cfg, attention_fn=attention_fn, train=train)
+    attn_bias = None
+    if attention_mask is not None:
+        attn_bias = jnp.where(attention_mask[:, None, None, :] > 0,
+                              0.0, -1e30).astype(jnp.float32)
+    body = partial(bert_block, cfg, attention_fn=attention_fn, train=train,
+                   attn_bias=attn_bias)
     if cfg.remat:
         from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
             checkpoint_policy)
@@ -222,23 +234,38 @@ def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
     return x
 
 
+def bert_mlm_logits(cfg: BertConfig, params: Dict, input_ids: Array,
+                    token_type_ids: Optional[Array] = None,
+                    attention_fn: Optional[Callable] = None,
+                    rng: Optional[Array] = None, train: bool = False,
+                    attention_mask: Optional[Array] = None) -> Array:
+    """Masked-LM logits [B, S, padded_vocab] — the encoder INFERENCE path
+    (fixed length, no KV cache; reference
+    ``module_inject/containers/bert.py`` / ``ds_bert`` serve the same
+    shape).  Decoder is tied to wte with the HF per-vocab bias."""
+    x = bert_encode(cfg, params, input_ids, token_type_ids, attention_fn,
+                    rng=rng, train=train, attention_mask=attention_mask)
+    dt = cfg.dtype
+    with jax.named_scope("mlm_head"):
+        h = x @ params["mlm_w"].astype(dt) + params["mlm_b"].astype(dt)
+        h = _activation(h, cfg.activation)
+        h = layer_norm(h, params["ln_mlm_g"], params["ln_mlm_b"], eps=cfg.ln_eps)
+        logits = (h @ params["wte"].astype(dt).T).astype(jnp.float32)
+        logits = logits + params["mlm_decoder_b"].astype(jnp.float32)
+        # padded vocab rows never win
+        pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
+        logits = jnp.where(pad_mask[None, None], -1e30, logits)
+    return _constrain(logits, mesh_lib.BATCH_AXES, "seq", "tensor")
+
+
 def bert_mlm_loss(cfg: BertConfig, params: Dict, input_ids: Array,
                   labels: Array, token_type_ids: Optional[Array] = None,
                   attention_fn: Optional[Callable] = None,
                   rng: Optional[Array] = None, train: bool = False) -> Array:
     """Masked-LM loss; positions with ``labels == -100`` are ignored
     (HF convention)."""
-    x = bert_encode(cfg, params, input_ids, token_type_ids, attention_fn,
-                    rng=rng, train=train)
-    dt = cfg.dtype
-    with jax.named_scope("mlm_head"):
-        h = x @ params["mlm_w"].astype(dt) + params["mlm_b"].astype(dt)
-        h = _activation(h, "gelu")
-        h = layer_norm(h, params["ln_mlm_g"], params["ln_mlm_b"], eps=cfg.ln_eps)
-        logits = (h @ params["wte"].astype(dt).T).astype(jnp.float32)
-        # padded vocab rows never win
-        pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
-        logits = jnp.where(pad_mask[None, None], -1e30, logits)
+    logits = bert_mlm_logits(cfg, params, input_ids, token_type_ids,
+                             attention_fn, rng=rng, train=train)
     valid = labels != -100
     tgt = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -271,3 +298,10 @@ class Bert:
 
     def forward_hidden(self, params, input_ids, token_type_ids=None):
         return bert_encode(self.cfg, params, input_ids, token_type_ids)
+
+    def forward_logits(self, params, input_ids, token_type_ids=None,
+                       attention_mask=None):
+        """InferenceEngine forward contract (encoder: full-sequence MLM
+        logits, no decode loop)."""
+        return bert_mlm_logits(self.cfg, params, input_ids, token_type_ids,
+                               attention_mask=attention_mask)

@@ -510,10 +510,73 @@ class HFGPTNeoXPolicy(InjectionPolicy):
         return cfg, params
 
 
+class HFBertPolicy(InjectionPolicy):
+    """HF BERT encoder (reference ``module_inject/containers/bert.py`` —
+    the first ENCODER injection path).  Maps BertForMaskedLM weights onto
+    the fused post-LN encoder (``models/bert.py``); serving is
+    fixed-length MLM logits (no KV cache)."""
+
+    model_types = ("bert",)
+
+    def build_model(self, hf_model):
+        from deepspeed_tpu.models.bert import Bert, BertConfig
+        hc = hf_model.config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        E = hc.hidden_size
+        cfg = BertConfig(vocab_size=hc.vocab_size,
+                         max_position_embeddings=hc.max_position_embeddings,
+                         type_vocab_size=hc.type_vocab_size,
+                         hidden_size=E,
+                         num_hidden_layers=hc.num_hidden_layers,
+                         num_attention_heads=hc.num_attention_heads,
+                         intermediate_size=hc.intermediate_size,
+                         ln_eps=hc.layer_norm_eps,
+                         activation=_map_activation(hc.hidden_act))
+        blocks = []
+        for i in range(cfg.num_hidden_layers):
+            b = f"bert.encoder.layer.{i}."
+            qkv_w = np.concatenate(
+                [sd[b + f"attention.self.{n}.weight"].T
+                 for n in ("query", "key", "value")], axis=1)
+            qkv_b = np.concatenate(
+                [sd[b + f"attention.self.{n}.bias"]
+                 for n in ("query", "key", "value")])
+            blocks.append({
+                "qkv_w": qkv_w, "qkv_b": qkv_b,
+                "out_w": sd[b + "attention.output.dense.weight"].T,
+                "out_b": sd[b + "attention.output.dense.bias"],
+                "ln1_g": sd[b + "attention.output.LayerNorm.weight"],
+                "ln1_b": sd[b + "attention.output.LayerNorm.bias"],
+                "fc_w": sd[b + "intermediate.dense.weight"].T,
+                "fc_b": sd[b + "intermediate.dense.bias"],
+                "proj_w": sd[b + "output.dense.weight"].T,
+                "proj_b": sd[b + "output.dense.bias"],
+                "ln2_g": sd[b + "output.LayerNorm.weight"],
+                "ln2_b": sd[b + "output.LayerNorm.bias"],
+            })
+        dec_b = np.zeros((cfg.padded_vocab,), np.float32)
+        dec_b[:hc.vocab_size] = sd["cls.predictions.bias"]
+        params = {
+            "wte": _pad_vocab(sd["bert.embeddings.word_embeddings.weight"],
+                              cfg.padded_vocab),
+            "wpe": sd["bert.embeddings.position_embeddings.weight"],
+            "wtt": sd["bert.embeddings.token_type_embeddings.weight"],
+            "ln_emb_g": sd["bert.embeddings.LayerNorm.weight"],
+            "ln_emb_b": sd["bert.embeddings.LayerNorm.bias"],
+            "blocks": _stack(blocks),
+            "mlm_w": sd["cls.predictions.transform.dense.weight"].T,
+            "mlm_b": sd["cls.predictions.transform.dense.bias"],
+            "ln_mlm_g": sd["cls.predictions.transform.LayerNorm.weight"],
+            "ln_mlm_b": sd["cls.predictions.transform.LayerNorm.bias"],
+            "mlm_decoder_b": dec_b,
+        }
+        return Bert(cfg), params
+
+
 def _with(cfg, **kw):
     import dataclasses
     return dataclasses.replace(cfg, **kw)
 
 
 _POLICIES = _POLICIES + (HFBloomPolicy, HFLlamaPolicy, HFGPTJPolicy,
-                         HFGPTNeoXPolicy)
+                         HFGPTNeoXPolicy, HFBertPolicy)

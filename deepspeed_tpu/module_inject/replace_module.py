@@ -29,10 +29,20 @@ def inject_hf_model(hf_model, policy: Optional[InjectionPolicy] = None,
     """
     policy = policy or policy_for_model(hf_model)
     if policy is None:
+        from deepspeed_tpu.module_inject.policies import _POLICIES
         mt = getattr(getattr(hf_model, "config", None), "model_type", None)
+        supported = sorted({t for pol in _POLICIES for t in pol.model_types})
         raise ValueError(
-            f"no injection policy for model_type={mt!r}; supported: gpt2, "
-            f"opt, gpt_neo — pass policy= for a custom architecture")
+            f"no injection policy for model_type={mt!r}; supported: "
+            f"{', '.join(supported)} — pass policy= for a custom architecture")
+    if hasattr(policy, "build_model"):
+        # encoder-family policies construct their own model object (e.g.
+        # Bert); decoder policies return (GPTConfig, params) below
+        model, params = policy.build_model(hf_model)
+        if dtype is not None:
+            import dataclasses
+            model.cfg = dataclasses.replace(model.cfg, dtype=dtype)
+        return model, params
     cfg, params = policy.build(hf_model)
     if dtype is not None:
         import dataclasses

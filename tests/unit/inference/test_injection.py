@@ -365,3 +365,47 @@ class TestGPTNeoXInjection:
         ours = np.asarray(engine(ids))[:, :, :97]
         ref = _hf_logits(model, ids)
         np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
+class TestBertInjection:
+    """Encoder injection (reference module_inject/containers/bert.py):
+    BertForMaskedLM served as fixed-length MLM logits through
+    init_inference — the first encoder-family policy."""
+
+    @pytest.fixture(scope="class")
+    def tiny_bert(self):
+        torch.manual_seed(4)
+        cfg = transformers.BertConfig(vocab_size=97, hidden_size=32,
+                                      num_hidden_layers=2, num_attention_heads=4,
+                                      intermediate_size=128,
+                                      max_position_embeddings=64)
+        return transformers.BertForMaskedLM(cfg).eval()
+
+    def test_mlm_logits_parity(self, tiny_bert, ids):
+        engine = deepspeed_tpu.init_inference(tiny_bert, dtype="float32")
+        ours = np.asarray(engine(ids))[:, :, :97]
+        with torch.no_grad():
+            ref = tiny_bert(torch.tensor(ids)).logits.float().numpy()
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+    def test_mlm_logits_parity_tp2(self, tiny_bert, ids):
+        engine = deepspeed_tpu.init_inference(
+            tiny_bert, dtype="float32", tensor_parallel={"tp_size": 2})
+        ours = np.asarray(engine(ids))[:, :, :97]
+        with torch.no_grad():
+            ref = tiny_bert(torch.tensor(ids)).logits.float().numpy()
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+    def test_padded_batch_attention_mask(self, tiny_bert, ids):
+        """Padded serving: pad tokens must not perturb real tokens' MLM
+        logits (the encoder's standard batched-serving input)."""
+        engine = deepspeed_tpu.init_inference(tiny_bert, dtype="float32")
+        padded = np.concatenate([ids, np.zeros((2, 4), ids.dtype)], axis=1)
+        mask = np.concatenate([np.ones_like(ids), np.zeros((2, 4), ids.dtype)],
+                              axis=1)
+        ours = np.asarray(engine.forward(padded, attention_mask=mask))
+        with torch.no_grad():
+            ref = tiny_bert(torch.tensor(padded),
+                            attention_mask=torch.tensor(mask)).logits
+        np.testing.assert_allclose(ours[:, :12, :97], ref.numpy()[:, :12],
+                                   atol=3e-4, rtol=3e-4)
