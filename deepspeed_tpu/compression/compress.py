@@ -47,9 +47,14 @@ class LeafPlan:
 
 class CompressionSpec:
 
-    def __init__(self, plans: Dict[str, LeafPlan], scheduler: CompressionScheduler):
+    def __init__(self, plans: Dict[str, LeafPlan], scheduler: CompressionScheduler,
+                 activation_quant: Optional[Dict] = None):
         self.plans = plans
         self.scheduler = scheduler
+        # model-side technique (reference QuantAct): the engine flips the
+        # model's activation_quant_bits when this is set — a parameter
+        # transform cannot reach activations
+        self.activation_quant = activation_quant
 
     def transform(self, params, enabled: Dict[str, bool],
                   rng: Optional[jax.Array] = None):
@@ -121,16 +126,6 @@ def init_compression(params, ds_config: Dict,
     ``num_heads`` feeds head pruning (the reference reads it from the
     group's ``related_modules``/mpu; here the caller states it)."""
     cfg = ds_config.get("compression_training", ds_config) or {}
-    if (cfg.get("activation_quantization", {})
-            .get("shared_parameters", {}).get("enabled", False)):
-        # activation quant lives inside the model's forward, which a pure
-        # parameter transform cannot reach — refuse loudly rather than
-        # silently skipping it; models call quantize_activation directly
-        raise NotImplementedError(
-            "activation_quantization is not wired through init_compression: "
-            "call deepspeed_tpu.compression.quantize_activation inside the "
-            "model's forward (the engine-side transform only touches "
-            "parameters)")
     plans: Dict[str, LeafPlan] = {}
 
     def plan(name) -> LeafPlan:
@@ -161,7 +156,16 @@ def init_compression(params, ds_config: Dict,
     bound = sum(len(p.active()) for p in plans.values())
     log_dist(f"init_compression: {bound} technique bindings over "
              f"{len(plans)} leaves", ranks=[0])
-    return CompressionSpec(plans, scheduler)
+    aq = cfg.get("activation_quantization", {}).get("shared_parameters", {})
+    activation_quant = None
+    if aq.get("enabled", False):
+        activation_quant = {
+            "bits": int(aq.get("quantize_bits", {}).get("start_bits", 8))
+            if isinstance(aq.get("quantize_bits"), dict)
+            else int(aq.get("bits", 8)),
+            "type": str(aq.get("quantization_type", "symmetric")),
+        }
+    return CompressionSpec(plans, scheduler, activation_quant=activation_quant)
 
 
 def redundancy_clean(params, spec: CompressionSpec,

@@ -94,6 +94,11 @@ class GPTConfig:
     rope_interleaved: bool = False
     # untied lm_head bias (GPT-J checkpoints carry one)
     head_bias: bool = False
+    # activation fake-quant (compression_training.activation_quantization;
+    # reference QuantAct, compression/basic_layer.py:404): bits on the
+    # normed inputs of the attention and MLP linears, STE gradients
+    activation_quant_bits: Optional[int] = None
+    activation_quant_type: str = "symmetric"
     # --- mixture-of-experts (reference deepspeed/moe): >0 replaces every
     # block's MLP with a top-k gated expert bank sharded over the 'expert'
     # mesh axis; the load-balance aux loss is added in gpt_loss ----------- #
@@ -440,6 +445,14 @@ def _dropout(x: Array, rate: float, rng: Optional[Array], train: bool) -> Array:
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
+def _maybe_actq(cfg: "GPTConfig", h: Array) -> Array:
+    if cfg.activation_quant_bits is None:
+        return h
+    from deepspeed_tpu.compression.basic_ops import quantize_activation
+    return quantize_activation(h, bits=cfg.activation_quant_bits,
+                               quant_type=cfg.activation_quant_type)
+
+
 def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
               train: bool, attention_fn: Callable) -> Tuple[Array, Array]:
     """One transformer block on ``x: [batch, seq, embd]``.  Returns
@@ -450,7 +463,7 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
     r = (jax.random.split(rng, 3) if rng is not None else (None, None, None))
 
     with jax.named_scope("attn"):
-        h = _norm(cfg, x, p["ln1_g"], p["ln1_b"])
+        h = _maybe_actq(cfg, _norm(cfg, x, p["ln1_g"], p["ln1_b"]))
         qkv = h @ _wget(p, "qkv_w", dt)
         if cfg.use_bias:
             qkv = qkv + p["qkv_b"].astype(dt)
@@ -483,7 +496,7 @@ def gpt_block(cfg: GPTConfig, p: Dict, x: Array, rng: Optional[Array],
     with jax.named_scope("mlp"):
         if cfg.block_type == "sequential":
             x = _constrain(x + o, mesh_lib.BATCH_AXES, "seq", None)
-            h2 = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+            h2 = _maybe_actq(cfg, _norm(cfg, x, p["ln2_g"], p["ln2_b"]))
             f, moe_aux = _ffn(cfg, p, h2, dt, rng=r[1], train=train)
             x = x + _dropout(f, cfg.dropout, r[2], train)
         elif cfg.block_type == "parallel":

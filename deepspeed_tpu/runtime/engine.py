@@ -249,6 +249,22 @@ class DeepSpeedEngine:
             self.compression_scheduler = self._compression_spec.scheduler
             self._compression_enabled = (
                 self.compression_scheduler.check_all_modules(0))
+            aq = self._compression_spec.activation_quant
+            mcfg = getattr(self.module, "cfg", None)
+            if aq is not None:
+                # model-side hook (reference QuantAct inserted by
+                # basic_layer.py:404): flip the model's activation
+                # fake-quant knobs, same pattern as the remat flip below
+                if mcfg is None or not hasattr(mcfg, "activation_quant_bits"):
+                    raise NotImplementedError(
+                        "activation_quantization requires a model exposing "
+                        "cfg.activation_quant_bits (the GPT family does)")
+                import dataclasses as _dc
+                self.module.cfg = _dc.replace(
+                    mcfg, activation_quant_bits=aq["bits"],
+                    activation_quant_type=aq["type"])
+                log_dist(f"compression: activation fake-quant enabled "
+                         f"({aq['bits']} bits, {aq['type']})", ranks=[0])
 
         # activation checkpointing: the config block selects the remat
         # policy (runtime/activation_checkpointing/checkpointing.py) and
