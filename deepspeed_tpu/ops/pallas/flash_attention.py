@@ -303,14 +303,18 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, S, group, has_bias,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, bq, bk, res, do):
-    q, k, v, bias, slopes, o, lse = res
+def flash_block_bwd(q, k, v, do, lse, delta, bias=None, slopes=None, *,
+                    causal, scale, bq=None, bk=None):
+    """Backward kernels against an EXTERNAL softmax normalizer: ``lse`` is
+    the (global) log-sum-exp [B, H, S, 1] and ``delta = sum(do * o)``
+    [B, H, S, 1].  Returns (dq, dk, dv).  This is the flash backward body —
+    exposed separately so ring attention (``parallel/sequence.py``) can use
+    it per KV hop with the final merged lse, which makes the distributed
+    backward exact without storing per-hop probabilities."""
     B, H, S, D = q.shape
     Hkv = k.shape[1]
     group = H // Hkv
     bq_, bk_ = _block_sizes(S, bq, bk)
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1, keepdims=True)                     # [B,H,S,1]
 
     qspec = pl.BlockSpec((1, 1, bq_, D), lambda b, h, i: (b, h, i, 0))
     kv_full = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0))
@@ -362,6 +366,19 @@ def _bwd(causal, scale, bq, bk, res, do):
         compiler_params=_PARALLEL3,
         interpret=_interpret(),
     )(*dkv_in)
+    return dq, dk, dv
+
+
+# [B, H, S, D] forward returning (o, lse) — the ring-attention hop body.
+flash_block_fwd = _fwd
+
+
+def _bwd(causal, scale, bq, bk, res, do):
+    q, k, v, bias, slopes, o, lse = res
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)                     # [B,H,S,1]
+    dq, dk, dv = flash_block_bwd(q, k, v, do, lse, delta, bias, slopes,
+                                 causal=causal, scale=scale, bq=bq, bk=bk)
     # both bias forms are constants under differentiation (module docstring)
     db = None if bias is None else jnp.zeros_like(bias)
     da = None if slopes is None else jnp.zeros_like(slopes)

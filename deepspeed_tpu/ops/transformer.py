@@ -109,11 +109,8 @@ class DeepSpeedTransformerLayer:
 
             def fn(p, x, r, bias):
                 attn = get_attention_fn("auto")
-                if bias is not None:
-                    attn = (lambda q, k, v, *, causal=False, inner=attn:
-                            inner(q, k, v, causal=causal, bias=bias))
-                return bert_block(cfg, p, x, attn,
-                                  rng=r, train=self.config.training)
+                return bert_block(cfg, p, x, attn, rng=r,
+                                  train=self.config.training, attn_bias=bias)
 
             self._fn = jax.jit(fn, static_argnames=())
         rng = rng if rng is not None else jax.random.key(0)

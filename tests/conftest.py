@@ -20,6 +20,14 @@ if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# NOTE: do NOT enable jax's persistent compilation cache here.  On CPU the
+# cache stores AOT machine code whose recorded target features
+# (+prefer-no-gather etc.) fail to match at reload in a fresh process on
+# this very machine — and the failed load SILENTLY yields zero-filled
+# outputs (observed: a checkpoint round-trip restoring all-zeros params).
+# Suite speed comes from shared fixtures instead.
+
 assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
 
 import pytest  # noqa: E402

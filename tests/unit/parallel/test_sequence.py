@@ -167,6 +167,51 @@ def test_ring_attention_alibi_slopes(seq_mesh):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_alibi_slopes_grad(seq_mesh, causal):
+    """Gradients through the flash-hop ring with the per-hop lse shift that
+    folds the ALiBi global-offset constant (round-5 backward path)."""
+    from deepspeed_tpu.ops.attention import alibi_bias, alibi_slopes
+    q, k, v = make_qkv(B=1, S=32, H=2, D=8, seed=11)
+    slopes = jnp.asarray(alibi_slopes(2))
+    bias = alibi_bias(2, 32, 32)
+
+    g_ring = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(
+            ring_attention(q, k, v, causal=causal, alibi=slopes) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            reference_attention(q, k, v, causal=causal, bias=bias) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=f"d{n}")
+
+
+def test_ring_attention_nondiv128_shard(seq_mesh):
+    """Shard length not a multiple of 128 still rides the flash ring with a
+    divisor block size (Sl=192 -> blk=96), not the dense fallback."""
+    q, k, v = make_qkv(B=1, S=768, H=2, D=8, seed=13)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=True))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gqa_uneven_falls_back(seq_mesh):
+    """ADVICE r4: grouped KV with Hkv not divisible by the seq*tensor head
+    sharding must not silently pad — it reroutes to ring attention."""
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    q = jax.random.normal(ks[0], (2, 64, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, 2, 16), jnp.float32)   # Hkv=2 < sp=4
+    v = jax.random.normal(ks[2], (2, 64, 2, 16), jnp.float32)
+    out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, causal=True))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_ulysses_alibi_slopes(seq_mesh):
     from deepspeed_tpu.ops.attention import alibi_bias, alibi_slopes
     q, k, v = make_qkv(seed=10)
