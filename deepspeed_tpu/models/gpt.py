@@ -31,6 +31,7 @@ TPU-first design decisions:
 
 import dataclasses
 import math
+import os
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -651,17 +652,21 @@ def chunked_cross_entropy(x: Array, head: Array, labels: Array,
     N = B * S
     if n_chunks <= 0:
         # chunking trades ~1/3 extra head FLOPs (backward recompute) for
-        # the [N, V] memory — only worth it once the logits block is big
-        # enough to threaten HBM (measured crossover on v5e-16GB: micro 16
-        # x 512 x 50k vocab = 1.65 GiB fits comfortably unchunked)
-        if N * V * 4 <= 1800 * 2 ** 20:
+        # the [N, V] memory.  Measured on v5e (r5): chunking LOSES while the
+        # block fits (micro 8 x 512 x 50k = 823 MiB: 90.6 unchunked vs 84.6
+        # chunked TFLOPs end-to-end) — the recompute costs more than the
+        # saved traffic — so the default only chunks past ~900 MiB, where
+        # capacity (OOM at micro 24+) forces it
+        threshold = int(os.environ.get("DST_CE_CHUNK_MIB", "900")) * 2 ** 20
+        if N * V * 4 <= threshold:
             n_chunks = 1
         else:
-            target_rows = max(1, (256 * 2 ** 20) // (4 * V))
-            n_chunks = max(1, N // target_rows)
-    while N % n_chunks:
-        n_chunks += 1
-    rows = N // n_chunks
+            target_rows = max(1, threshold // (4 * V))
+            n_chunks = max(1, -(-N // target_rows))
+    # rows are PADDED up to n_chunks * rows (pad rows masked out of the
+    # mean) — never a divisor hunt, which degenerates for prime-ish N
+    rows = -(-N // n_chunks)
+    n_pad = n_chunks * rows - N
     if n_chunks == 1:
         logits = (x.reshape(N, E) @ head.astype(x.dtype).T).astype(jnp.float32)
         if head_b is not None:
@@ -672,12 +677,19 @@ def chunked_cross_entropy(x: Array, head: Array, labels: Array,
         ll = jnp.sum(logits * jax.nn.one_hot(labels.reshape(N), V,
                                              dtype=logits.dtype), axis=-1)
         return jnp.mean(lse - ll)
-    xc = x.reshape(n_chunks, rows, E)
-    lc = labels.reshape(n_chunks, rows)
+    xf = x.reshape(N, E)
+    lf = labels.reshape(N)
+    valid = None
+    if n_pad:
+        xf = jnp.concatenate([xf, jnp.zeros((n_pad, E), xf.dtype)])
+        lf = jnp.concatenate([lf, jnp.zeros((n_pad,), lf.dtype)])
+        valid = (jnp.arange(n_chunks * rows) < N).reshape(n_chunks, rows)
+    xc = xf.reshape(n_chunks, rows, E)
+    lc = lf.reshape(n_chunks, rows)
     mask_pad = V != vocab_size
 
     def chunk(total, xs):
-        xch, lch = xs
+        xch, lch = xs[0], xs[1]
         logits = (xch @ head.astype(xch.dtype).T).astype(jnp.float32)  # [rows, V]
         if head_b is not None:
             logits = logits + head_b.astype(jnp.float32)
@@ -691,10 +703,14 @@ def chunked_cross_entropy(x: Array, head: Array, labels: Array,
         # materializing it
         ll = jnp.sum(logits * jax.nn.one_hot(lch, V, dtype=logits.dtype),
                      axis=-1)
-        return total + jnp.sum(lse - ll), None
+        nll = lse - ll
+        if valid is not None:
+            nll = jnp.where(xs[2], nll, 0.0)
+        return total + jnp.sum(nll), None
 
+    xs = (xc, lc) if valid is None else (xc, lc, valid)
     total, _ = jax.lax.scan(jax.checkpoint(chunk), jnp.zeros((), jnp.float32),
-                            (xc, lc))
+                            xs)
     return total / N
 
 

@@ -137,11 +137,10 @@ def alibi_bias(num_heads: int, q_len: int, k_len: int,
 
 
 # Below this sequence length XLA's fused dense attention beats the Pallas
-# flash kernel on-chip (measured on v5e: 68.0 vs 63.0 TFLOPs/chip end-to-end
-# at S=512 — the flash inner loop is VPU-bound at short S, while the O(S^2)
-# score tensor XLA materializes is still cheap).  Beyond it, flash's O(S)
-# memory and tiling win.
-XLA_FUSED_MAX_SEQ = 512
+# flash kernel on-chip (r5, v5e, bf16-MXU kernels with (256, 512) blocks:
+# flash wins from S=512 up — fwd+bwd 0.386ms vs 0.411ms dense at S=512,
+# micro 8 — and the gap widens with S while dense goes O(S^2) in memory).
+XLA_FUSED_MAX_SEQ = 256
 
 
 def auto_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None):

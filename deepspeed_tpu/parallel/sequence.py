@@ -61,8 +61,11 @@ def ulysses_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None,
                       inner: Optional[Callable] = None):
     """All-to-all head/sequence re-sharding attention (DeepSpeed-Ulysses
     scheme, built after the reference's era).  Requires ``heads % sp == 0``
-    for q AND for the (grouped) KV head count — uneven KV heads fall back
-    to ring attention, which shards sequence, not heads."""
+    for q AND for the (grouped) KV head count.  Uneven KV heads (with even
+    q heads) are expanded to full head count so the a2a shards evenly —
+    O(S · H) KV memory, the documented trade; uneven q heads reroute to
+    ring attention (sequence-sharded, never expands) unless the caller
+    pinned an ``inner`` kernel."""
     from deepspeed_tpu.ops.attention import (reference_attention,
                                              expand_kv_heads, canonical_bias)
     caller_inner = inner is not None

@@ -866,8 +866,15 @@ class DeepSpeedEngine:
             params, opt_state, _ = args
             return params, opt_state
 
-        new_params, new_opt = jax.lax.cond(overflow, skip_step, do_step,
-                                           (params, opt_state, grads))
+        if momentum_mode or not self.fp16_enabled:
+            # no dynamic loss scaling → overflow is the constant False; a
+            # lax.cond here would force the whole f32 grad tree to
+            # materialize at the branch boundary instead of fusing the
+            # cast/unscale/clip into the update's single memory pass
+            new_params, new_opt = do_step((params, opt_state, grads))
+        else:
+            new_params, new_opt = jax.lax.cond(overflow, skip_step, do_step,
+                                               (params, opt_state, grads))
         new_scaler = update_scale(scaler, overflow)
         new_skipped = skipped + overflow.astype(jnp.int32)
         stats = {"grad_norm": grad_norm, "overflow": overflow, "loss_scale": new_scaler.scale}

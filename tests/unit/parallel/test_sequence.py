@@ -110,7 +110,7 @@ def test_ring_attention_bias_grad(seq_mesh):
 
 
 def test_ring_attention_gqa(seq_mesh):
-    """Grouped KV through ring attention (expanded per-shard)."""
+    """Grouped KV through ring attention (circulated at native Hkv)."""
     ks = jax.random.split(jax.random.PRNGKey(7), 3)
     q = jax.random.normal(ks[0], (2, 64, 4, 16), jnp.float32)
     k = jax.random.normal(ks[1], (2, 64, 2, 16), jnp.float32)
@@ -199,13 +199,27 @@ def test_ring_attention_nondiv128_shard(seq_mesh):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_ulysses_gqa_uneven_falls_back(seq_mesh):
+def test_ulysses_gqa_uneven_expands(seq_mesh):
     """ADVICE r4: grouped KV with Hkv not divisible by the seq*tensor head
-    sharding must not silently pad — it reroutes to ring attention."""
+    sharding must not silently uneven-shard — KV is expanded to full head
+    count so the a2a stays even (q heads divisible -> expand branch)."""
     ks = jax.random.split(jax.random.PRNGKey(12), 3)
     q = jax.random.normal(ks[0], (2, 64, 8, 16), jnp.float32)
     k = jax.random.normal(ks[1], (2, 64, 2, 16), jnp.float32)   # Hkv=2 < sp=4
     v = jax.random.normal(ks[2], (2, 64, 2, 16), jnp.float32)
+    out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, causal=True))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_uneven_q_heads_reroutes_to_ring(seq_mesh):
+    """Uneven q heads (H=6 vs seq*tensor=4) with the default inner take the
+    ring path (sequence-sharded) instead of a padded head a2a."""
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(ks[0], (2, 64, 6, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, 6, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, 6, 16), jnp.float32)
     out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, causal=True))(q, k, v)
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
