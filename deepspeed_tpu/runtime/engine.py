@@ -885,7 +885,12 @@ class DeepSpeedEngine:
         out_shardings = (self.param_shardings, self.opt_shardings, jax.tree.map(lambda _: repl, self.state.scaler),
                          repl, {"grad_norm": repl, "overflow": repl, "loss_scale": repl})
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4), out_shardings=out_shardings)
+        # acc (arg 2) is NOT donated: every output slot of matching
+        # shape/dtype is already aliased by params/opt_state (donated
+        # first), so donating the grad buffer cannot be honored and only
+        # produces XLA's "donated buffers were not usable" warning; its
+        # memory is freed right after the call (state.grad_acc = None)
+        @partial(jax.jit, donate_argnums=(0, 1, 3, 4), out_shardings=out_shardings)
         def apply_step(params, opt_state, acc, scaler, skipped):
             return self._apply_updates(params, opt_state, acc, scaler, skipped,
                                        momentum_mode=momentum_mode)

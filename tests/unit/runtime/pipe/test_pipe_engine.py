@@ -153,37 +153,52 @@ def test_1f1b_heterogeneous_stages():
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+def _compiled_temp_bytes(schedule: str, M: int, seed: int) -> int:
+    """Temp memory of the compiled pipeline gradient program (2 stages,
+    dp=4) at M micro-batches."""
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    cfg = tiny_cfg(n_layer=4, n_embd=128, n_head=4, n_positions=128)
+    mesh = MeshSpec(pipe=2, data=4, device_count=8).build(jax.devices()[:8])
+    mesh_lib.reset_mesh()
+    module = gpt_pipeline_module(cfg, num_stages=2)
+    engine = PipelineEngine(model=module, mesh=mesh, config={
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": M,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "pipeline": {"schedule": schedule},
+    })
+    adapted = engine._adapted
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (M, 4, 128)), jnp.int32)
+    if schedule == "1f1b":
+        fn = jax.jit(lambda p, b: adapted.value_and_grad(p, b, None, True)[1])
+    else:
+        fn = jax.jit(jax.grad(lambda p, b: adapted(p, b, None, True)))
+    comp = fn.lower(engine.state.params, (ids, ids)).compile()
+    return comp.memory_analysis().temp_size_in_bytes
+
+
 def test_1f1b_memory_scales_with_stages_not_micros():
     """The 1F1B claim, proven on compiled programs (SURVEY §7 hard-part 2):
     at many micro-batches the 1F1B gradient program's temp memory must be
     well under the GPipe program's, whose saved residuals grow ∝ M."""
-    cfg = tiny_cfg(n_layer=4, n_embd=128, n_head=4, n_positions=128)
-    mesh = MeshSpec(pipe=2, data=4, device_count=8).build(jax.devices()[:8])
-    M = 16
-    rng = np.random.default_rng(5)
-    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (M, 4, 128)), jnp.int32)
-
-    temps = {}
-    for schedule in ("gpipe", "1f1b"):
-        from deepspeed_tpu.parallel import mesh as mesh_lib
-        mesh_lib.reset_mesh()
-        module = gpt_pipeline_module(cfg, num_stages=2)
-        engine = PipelineEngine(model=module, mesh=mesh, config={
-            "train_micro_batch_size_per_gpu": 4,
-            "gradient_accumulation_steps": M,
-            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-            "pipeline": {"schedule": schedule},
-        })
-        adapted = engine._adapted
-        if schedule == "1f1b":
-            fn = jax.jit(lambda p, b: adapted.value_and_grad(p, b, None, True)[1])
-        else:
-            fn = jax.jit(jax.grad(lambda p, b: adapted(p, b, None, True)))
-        comp = fn.lower(engine.state.params, (ids, ids)).compile()
-        temps[schedule] = comp.memory_analysis().temp_size_in_bytes
+    temps = {s: _compiled_temp_bytes(s, M=16, seed=5)
+             for s in ("gpipe", "1f1b")}
     # 1f1b holds ≤ 2P stage inputs; gpipe's differentiated scan holds every
     # tick's residuals (∝ M).  Require a decisive margin, not noise.
     assert temps["1f1b"] < 0.6 * temps["gpipe"], temps
+
+
+def test_1f1b_memory_flat_in_micro_count():
+    """Steady-state 1F1B live memory is ∝ stages (the 2P-slot circular
+    activation buffer), NOT ∝ micro-batches: doubling M must leave the
+    compiled temp size essentially unchanged (reference
+    ``pipe/schedule.py:189`` exists for exactly this bound)."""
+    temps = {M: _compiled_temp_bytes("1f1b", M=M, seed=6) for M in (8, 16)}
+    # the batch itself is an argument (not temp); only the fixed-depth
+    # save buffer and per-stage grads live in temp — allow 15% slack for
+    # scheduling noise, nothing M-proportional
+    assert temps[16] < 1.15 * temps[8], temps
 
 
 def test_partition_methods():
