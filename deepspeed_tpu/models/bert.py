@@ -50,6 +50,12 @@ class BertConfig:
     ln_eps: float = 1e-12
     activation: str = "gelu"     # HF hidden_act (exact gelu for stock BERT)
     vocab_multiple: int = 128
+    # encoder-family variants sharing the fused block (reference serves
+    # these via separate containers — distil_bert.py, clip.py):
+    causal: bool = False         # CLIP text towers are causal encoders
+    embed_layernorm: bool = True   # DistilBERT yes, CLIP no
+    final_layernorm: bool = False  # CLIP final_layer_norm (params ln_f_g/b)
+    mlm_head: bool = True          # towers without an MLM head skip it
 
     def __post_init__(self):
         self.padded_vocab = int(math.ceil(
@@ -102,23 +108,32 @@ def init_bert_params(cfg: BertConfig, rng: Array) -> Dict:
     else:
         blocks = {f"h{i}": _init_block(cfg, k)
                   for i, k in enumerate(jax.random.split(ks[0], L))}
-    return {
+    p = {
         "wte": _dense_init(ks[1], cfg.padded_vocab, (cfg.padded_vocab, E)),
         "wpe": _dense_init(ks[2], cfg.max_position_embeddings,
                            (cfg.max_position_embeddings, E), scale=0.01),
-        "wtt": _dense_init(ks[3], cfg.type_vocab_size,
-                           (cfg.type_vocab_size, E), scale=0.01),
-        "ln_emb_g": jnp.ones((E,), jnp.float32),
-        "ln_emb_b": jnp.zeros((E,), jnp.float32),
         "blocks": blocks,
+    }
+    if cfg.type_vocab_size > 0:
+        p["wtt"] = _dense_init(ks[3], cfg.type_vocab_size,
+                               (cfg.type_vocab_size, E), scale=0.01)
+    if cfg.embed_layernorm:
+        p["ln_emb_g"] = jnp.ones((E,), jnp.float32)
+        p["ln_emb_b"] = jnp.zeros((E,), jnp.float32)
+    if cfg.final_layernorm:
+        p["ln_f_g"] = jnp.ones((E,), jnp.float32)
+        p["ln_f_b"] = jnp.zeros((E,), jnp.float32)
+    if cfg.mlm_head:
         # MLM transform head (dense + LN; decoder tied to wte + per-vocab
         # bias, the HF cls.predictions.bias)
-        "mlm_w": _dense_init(ks[4], E, (E, E)),
-        "mlm_b": jnp.zeros((E,), jnp.float32),
-        "ln_mlm_g": jnp.ones((E,), jnp.float32),
-        "ln_mlm_b": jnp.zeros((E,), jnp.float32),
-        "mlm_decoder_b": jnp.zeros((cfg.padded_vocab,), jnp.float32),
-    }
+        p.update({
+            "mlm_w": _dense_init(ks[4], E, (E, E)),
+            "mlm_b": jnp.zeros((E,), jnp.float32),
+            "ln_mlm_g": jnp.ones((E,), jnp.float32),
+            "ln_mlm_b": jnp.zeros((E,), jnp.float32),
+            "mlm_decoder_b": jnp.zeros((cfg.padded_vocab,), jnp.float32),
+        })
+    return p
 
 
 _BLOCK_SPECS = {
@@ -139,15 +154,26 @@ def bert_partition_specs(cfg: BertConfig) -> Dict:
     blocks = (block_specs(True) if cfg.scan_layers
               else {f"h{i}": block_specs(False)
                     for i in range(cfg.num_hidden_layers)})
-    return {
+    specs = {
         "wte": PartitionSpec("tensor", None),
-        "wpe": PartitionSpec(), "wtt": PartitionSpec(),
-        "ln_emb_g": PartitionSpec(), "ln_emb_b": PartitionSpec(),
+        "wpe": PartitionSpec(),
         "blocks": blocks,
-        "mlm_w": PartitionSpec(), "mlm_b": PartitionSpec(),
-        "ln_mlm_g": PartitionSpec(), "ln_mlm_b": PartitionSpec(),
-        "mlm_decoder_b": PartitionSpec("tensor"),
     }
+    if cfg.type_vocab_size > 0:
+        specs["wtt"] = PartitionSpec()
+    if cfg.embed_layernorm:
+        specs["ln_emb_g"] = PartitionSpec()
+        specs["ln_emb_b"] = PartitionSpec()
+    if cfg.final_layernorm:
+        specs["ln_f_g"] = PartitionSpec()
+        specs["ln_f_b"] = PartitionSpec()
+    if cfg.mlm_head:
+        specs.update({
+            "mlm_w": PartitionSpec(), "mlm_b": PartitionSpec(),
+            "ln_mlm_g": PartitionSpec(), "ln_mlm_b": PartitionSpec(),
+            "mlm_decoder_b": PartitionSpec("tensor"),
+        })
+    return specs
 
 
 # --------------------------------------------------------------------------- #
@@ -167,7 +193,8 @@ def bert_block(cfg: BertConfig, p: Dict, x: Array,
         q = _constrain(q.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
         k = _constrain(k.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
         v = _constrain(v.reshape(B, S, H, D), mesh_lib.BATCH_AXES, "seq", "tensor", None)
-        o = attention_fn(q, k, v, causal=False, bias=attn_bias).reshape(B, S, E)
+        o = attention_fn(q, k, v, causal=cfg.causal,
+                         bias=attn_bias).reshape(B, S, E)
         return o @ p["out_w"].astype(dt) + p["out_b"].astype(dt)
 
     def mlp(h):
@@ -196,17 +223,32 @@ def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
     attention_fn = attention_fn or get_attention_fn(cfg.attn_impl)
     B, S = input_ids.shape
     dt = cfg.dtype
-    use_rngs = rng is not None and train
     with jax.named_scope("embed"):
         x = params["wte"].astype(dt)[input_ids]
         x = x + params["wpe"].astype(dt)[:S][None]
-        tt = (token_type_ids if token_type_ids is not None
-              else jnp.zeros_like(input_ids))
-        x = x + params["wtt"].astype(dt)[tt]
-        x = layer_norm(x, params["ln_emb_g"], params["ln_emb_b"], eps=cfg.ln_eps)
+        if cfg.type_vocab_size > 0:
+            tt = (token_type_ids if token_type_ids is not None
+                  else jnp.zeros_like(input_ids))
+            x = x + params["wtt"].astype(dt)[tt]
+        if cfg.embed_layernorm:
+            x = layer_norm(x, params["ln_emb_g"], params["ln_emb_b"],
+                           eps=cfg.ln_eps)
         x = _dropout(x, cfg.hidden_dropout_prob, rng, train)
         x = _constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+    return bert_encoder_stack(cfg, params, x, attention_fn, rng=rng,
+                              train=train, attention_mask=attention_mask)
 
+
+def bert_encoder_stack(cfg: BertConfig, params: Dict, x: Array,
+                       attention_fn: Optional[Callable] = None,
+                       rng: Optional[Array] = None, train: bool = False,
+                       attention_mask: Optional[Array] = None) -> Array:
+    """The block stack on pre-embedded hidden states ``x`` [B, S, E] —
+    shared by BERT/DistilBERT (token embeddings) and the CLIP towers
+    (text embeddings / vision patch embeddings, ``models/clip.py``)."""
+    from deepspeed_tpu.ops.attention import get_attention_fn
+    attention_fn = attention_fn or get_attention_fn(cfg.attn_impl)
+    use_rngs = rng is not None and train
     attn_bias = None
     if attention_mask is not None:
         attn_bias = jnp.where(attention_mask[:, None, None, :] > 0,
@@ -231,6 +273,8 @@ def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
         for i in range(cfg.num_hidden_layers):
             r = jax.random.fold_in(rng, i) if use_rngs else None
             x = body(params["blocks"][f"h{i}"], x, rng=r)
+    if cfg.final_layernorm:
+        x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], eps=cfg.ln_eps)
     return x
 
 

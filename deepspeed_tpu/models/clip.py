@@ -1,0 +1,192 @@
+"""CLIP text + vision towers — encoder serving for multimodal pipelines.
+
+Reference: ``deepspeed/module_inject/containers/clip.py`` (HFCLIPLayerPolicy
+feeds both CLIP towers through the fused inference transformer for Stable
+Diffusion's text encoder) and the ``generic_injection`` path
+(``module_inject/replace_module.py:182``).
+
+TPU-native: both towers ARE the fused encoder stack of ``models/bert.py``
+(``bert_encoder_stack``) — pre-LN blocks, quick-gelu MLP, flash/XLA
+attention, scan-over-layers — parameterized by ``BertConfig``:
+
+* **Text tower**: causal encoder (CLIP trains its text side with a causal
+  mask), token + position embeddings, no embedding LN, final LN.  Pooled
+  output is the EOS-position hidden state (argmax of ``ids == eos``).
+* **Vision tower**: non-overlapping patch embedding — a strided conv in
+  the HF module, expressed here as reshape + one MXU matmul (identical
+  math: each P x P patch flattens to a row times the [3*P*P, E] kernel) —
+  class token, learned position embeddings, pre-LN before the stack, and
+  post-LN on the CLS row.
+
+The diffusers UNet/VAE side of the reference's Stable-Diffusion stack is
+descoped (see README "Descoped" table): its value is conv-heavy diffusion
+serving, which is a different framework's job; the CLIP/text half — what
+LLM-side pipelines consume — is fully served here.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.models.bert import (BertConfig, bert_encoder_stack,
+                                       init_bert_params,
+                                       bert_partition_specs)
+from deepspeed_tpu.models.gpt import layer_norm, _dense_init
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+Array = jax.Array
+
+
+def clip_text_config(vocab_size=49408, max_position_embeddings=77,
+                     hidden_size=512, num_hidden_layers=12,
+                     num_attention_heads=8, intermediate_size=2048,
+                     ln_eps=1e-5, activation="gelu_quick",
+                     **overrides) -> BertConfig:
+    """CLIPTextConfig -> the fused encoder's config: causal pre-LN blocks,
+    no token-type / embedding-LN, final LN, no MLM head."""
+    kw = dict(vocab_size=vocab_size,
+              max_position_embeddings=max_position_embeddings,
+              hidden_size=hidden_size, num_hidden_layers=num_hidden_layers,
+              num_attention_heads=num_attention_heads,
+              intermediate_size=intermediate_size, ln_eps=ln_eps,
+              activation=activation, pre_ln=True, causal=True,
+              type_vocab_size=0, embed_layernorm=False, final_layernorm=True,
+              mlm_head=False, vocab_multiple=1)
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+class CLIPTextEncoder:
+    """CLIP text tower (reference container ``clip.py`` HFCLIPLayerPolicy).
+    ``forward_logits`` (the InferenceEngine encoder contract) returns the
+    final hidden states [B, S, E]."""
+
+    def __init__(self, cfg: BertConfig, eos_token_id: int = 49407):
+        self.cfg = cfg
+        self.eos_token_id = eos_token_id
+
+    def init_params(self, rng):
+        return init_bert_params(self.cfg, rng)
+
+    def partition_specs(self):
+        return bert_partition_specs(self.cfg)
+
+    def forward_logits(self, params, input_ids, attention_mask=None):
+        cfg = self.cfg
+        dt = cfg.dtype
+        S = input_ids.shape[1]
+        x = params["wte"].astype(dt)[input_ids]
+        x = x + params["wpe"].astype(dt)[:S][None]
+        x = mesh_lib.constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+        return bert_encoder_stack(cfg, params, x,
+                                  attention_mask=attention_mask)
+
+    def pooled(self, params, input_ids, attention_mask=None):
+        """EOS-position hidden state, matching HF CLIPTextModel
+        pooler_output exactly: legacy configs carry ``eos_token_id == 2``
+        while the real EOS is the highest token id, so HF pools at
+        ``input_ids.argmax(-1)`` for them; otherwise at the FIRST
+        occurrence of the configured eos token."""
+        h = self.forward_logits(params, input_ids, attention_mask)
+        if self.eos_token_id == 2:    # HF's legacy-config special case
+            idx = jnp.argmax(input_ids, axis=1)
+        else:
+            idx = jnp.argmax((input_ids == self.eos_token_id).astype(jnp.int32),
+                             axis=1)
+        return jax.vmap(lambda row, i: row[i])(h, idx)
+
+
+@dataclasses.dataclass
+class CLIPVisionConfig:
+    image_size: int = 224
+    patch_size: int = 32
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    ln_eps: float = 1e-5
+    activation: str = "gelu_quick"
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    attn_impl: str = "auto"
+
+    def __post_init__(self):
+        assert self.image_size % self.patch_size == 0
+        self.n_patches = (self.image_size // self.patch_size) ** 2
+        self.encoder = BertConfig(
+            vocab_size=1, hidden_size=self.hidden_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            intermediate_size=self.intermediate_size, ln_eps=self.ln_eps,
+            activation=self.activation, pre_ln=True, causal=False,
+            type_vocab_size=0, embed_layernorm=False, final_layernorm=False,
+            mlm_head=False, vocab_multiple=1, dtype=self.dtype,
+            scan_layers=self.scan_layers, attn_impl=self.attn_impl,
+            max_position_embeddings=self.n_patches + 1)
+
+
+class CLIPVisionEncoder:
+    """CLIP vision tower: patch-matmul embedding + CLS token + pre/post LN
+    around the shared fused encoder stack."""
+
+    def __init__(self, cfg: CLIPVisionConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng):
+        cfg = self.cfg
+        ks = jax.random.split(rng, 3)
+        E, P = cfg.hidden_size, cfg.patch_size
+        p = init_bert_params(cfg.encoder, ks[0])
+        del p["wte"], p["wpe"]    # vision embeds pixels, not ids
+        p.update({
+            "patch_w": _dense_init(ks[1], 3 * P * P, (3 * P * P, E)),
+            "class_emb": jnp.zeros((E,), jnp.float32),
+            "pos_emb": _dense_init(ks[2], cfg.n_patches + 1,
+                                   (cfg.n_patches + 1, E), scale=0.01),
+            "pre_ln_g": jnp.ones((E,), jnp.float32),
+            "pre_ln_b": jnp.zeros((E,), jnp.float32),
+            "post_ln_g": jnp.ones((E,), jnp.float32),
+            "post_ln_b": jnp.zeros((E,), jnp.float32),
+        })
+        return p
+
+    def partition_specs(self):
+        specs = bert_partition_specs(self.cfg.encoder)
+        del specs["wte"], specs["wpe"]
+        specs.update({
+            "patch_w": PartitionSpec(None, "tensor"),
+            "class_emb": PartitionSpec(), "pos_emb": PartitionSpec(),
+            "pre_ln_g": PartitionSpec(), "pre_ln_b": PartitionSpec(),
+            "post_ln_g": PartitionSpec(), "post_ln_b": PartitionSpec(),
+        })
+        return specs
+
+    def forward_logits(self, params, pixel_values):
+        """[B, 3, H, W] float pixels -> final hidden states [B, N+1, E]
+        (HF last_hidden_state; ``pooled`` applies the post-LN CLS)."""
+        cfg = self.cfg
+        dt = cfg.dtype
+        P = cfg.patch_size
+        B, C, H, W = pixel_values.shape
+        g = H // P
+        # strided conv as reshape + matmul: [B, N, C*P*P] @ [C*P*P, E].
+        # HF's Conv2d kernel is [E, C, P, P]; the policy flattens it in
+        # (C, P, P) order, matched by the transpose below.
+        x = pixel_values.astype(dt).reshape(B, C, g, P, g, P)
+        x = x.transpose(0, 2, 4, 1, 3, 5).reshape(B, g * g, C * P * P)
+        x = x @ params["patch_w"].astype(dt)
+        cls = jnp.broadcast_to(params["class_emb"].astype(dt), (B, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        x = x + params["pos_emb"].astype(dt)[None]
+        x = layer_norm(x, params["pre_ln_g"], params["pre_ln_b"],
+                       eps=cfg.ln_eps)
+        x = mesh_lib.constrain(x, mesh_lib.BATCH_AXES, "seq", None)
+        return bert_encoder_stack(cfg.encoder, params, x)
+
+    def pooled(self, params, pixel_values):
+        h = self.forward_logits(params, pixel_values)
+        return layer_norm(h[:, 0], params["post_ln_g"], params["post_ln_b"],
+                          eps=self.cfg.ln_eps)

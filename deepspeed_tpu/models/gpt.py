@@ -320,6 +320,8 @@ def _activation(x: Array, kind: str) -> Array:
         return jax.nn.gelu(x, approximate=False)
     if kind == "relu":
         return jax.nn.relu(x)
+    if kind == "gelu_quick":       # CLIP's quick_gelu: x * sigmoid(1.702x)
+        return x * jax.nn.sigmoid(1.702 * x)
     raise ValueError(f"unknown activation {kind!r}")
 
 

@@ -42,6 +42,7 @@ def _stack(blocks) -> Dict[str, np.ndarray]:
 
 
 _HF_ACTIVATIONS = {"relu": "relu", "gelu": "gelu",
+                   "quick_gelu": "gelu_quick",
                    "gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh"}
 
 
@@ -573,10 +574,166 @@ class HFBertPolicy(InjectionPolicy):
         return Bert(cfg), params
 
 
+class HFDistilBertPolicy(InjectionPolicy):
+    """HF DistilBERT (reference ``module_inject/containers/distil_bert.py``).
+    Same fused post-LN encoder as BERT with no token-type embeddings;
+    separate q/k/v linears concatenate into the fused qkv."""
+
+    model_types = ("distilbert",)
+
+    def build_model(self, hf_model):
+        from deepspeed_tpu.models.bert import Bert, BertConfig
+        hc = hf_model.config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        cfg = BertConfig(vocab_size=hc.vocab_size,
+                         max_position_embeddings=hc.max_position_embeddings,
+                         type_vocab_size=0,
+                         hidden_size=hc.dim,
+                         num_hidden_layers=hc.n_layers,
+                         num_attention_heads=hc.n_heads,
+                         intermediate_size=hc.hidden_dim,
+                         ln_eps=1e-12,
+                         activation=_map_activation(hc.activation))
+        blocks = []
+        for i in range(cfg.num_hidden_layers):
+            b = f"distilbert.transformer.layer.{i}."
+            qkv_w = np.concatenate(
+                [sd[b + f"attention.{n}.weight"].T
+                 for n in ("q_lin", "k_lin", "v_lin")], axis=1)
+            qkv_b = np.concatenate(
+                [sd[b + f"attention.{n}.bias"]
+                 for n in ("q_lin", "k_lin", "v_lin")])
+            blocks.append({
+                "qkv_w": qkv_w, "qkv_b": qkv_b,
+                "out_w": sd[b + "attention.out_lin.weight"].T,
+                "out_b": sd[b + "attention.out_lin.bias"],
+                "ln1_g": sd[b + "sa_layer_norm.weight"],
+                "ln1_b": sd[b + "sa_layer_norm.bias"],
+                "fc_w": sd[b + "ffn.lin1.weight"].T,
+                "fc_b": sd[b + "ffn.lin1.bias"],
+                "proj_w": sd[b + "ffn.lin2.weight"].T,
+                "proj_b": sd[b + "ffn.lin2.bias"],
+                "ln2_g": sd[b + "output_layer_norm.weight"],
+                "ln2_b": sd[b + "output_layer_norm.bias"],
+            })
+        dec_b = np.zeros((cfg.padded_vocab,), np.float32)
+        dec_b[:hc.vocab_size] = sd["vocab_projector.bias"]
+        params = {
+            "wte": _pad_vocab(sd["distilbert.embeddings.word_embeddings.weight"],
+                              cfg.padded_vocab),
+            "wpe": sd["distilbert.embeddings.position_embeddings.weight"],
+            "ln_emb_g": sd["distilbert.embeddings.LayerNorm.weight"],
+            "ln_emb_b": sd["distilbert.embeddings.LayerNorm.bias"],
+            "blocks": _stack(blocks),
+            # vocab_transform + vocab_layer_norm + tied vocab_projector map
+            # exactly onto the BERT MLM transform head
+            "mlm_w": sd["vocab_transform.weight"].T,
+            "mlm_b": sd["vocab_transform.bias"],
+            "ln_mlm_g": sd["vocab_layer_norm.weight"],
+            "ln_mlm_b": sd["vocab_layer_norm.bias"],
+            "mlm_decoder_b": dec_b,
+        }
+        return Bert(cfg), params
+
+
+def _clip_encoder_blocks(sd: Dict[str, np.ndarray], prefix: str, L: int):
+    """CLIP encoder layer -> fused block mapping (shared by both towers):
+    layer_norm1/2 are the pre-LNs, self_attn carries separate q/k/v/out."""
+    blocks = []
+    for i in range(L):
+        b = f"{prefix}encoder.layers.{i}."
+        qkv_w = np.concatenate(
+            [sd[b + f"self_attn.{n}.weight"].T
+             for n in ("q_proj", "k_proj", "v_proj")], axis=1)
+        qkv_b = np.concatenate(
+            [sd[b + f"self_attn.{n}.bias"]
+             for n in ("q_proj", "k_proj", "v_proj")])
+        blocks.append({
+            "qkv_w": qkv_w, "qkv_b": qkv_b,
+            "out_w": sd[b + "self_attn.out_proj.weight"].T,
+            "out_b": sd[b + "self_attn.out_proj.bias"],
+            "ln1_g": sd[b + "layer_norm1.weight"],
+            "ln1_b": sd[b + "layer_norm1.bias"],
+            "fc_w": sd[b + "mlp.fc1.weight"].T,
+            "fc_b": sd[b + "mlp.fc1.bias"],
+            "proj_w": sd[b + "mlp.fc2.weight"].T,
+            "proj_b": sd[b + "mlp.fc2.bias"],
+            "ln2_g": sd[b + "layer_norm2.weight"],
+            "ln2_b": sd[b + "layer_norm2.bias"],
+        })
+    return _stack(blocks)
+
+
+class HFCLIPTextPolicy(InjectionPolicy):
+    """HF CLIPTextModel (reference ``module_inject/containers/clip.py``,
+    HFCLIPLayerPolicy — Stable Diffusion's text encoder).  Causal pre-LN
+    tower with a final LN; serves last hidden states."""
+
+    model_types = ("clip_text_model",)
+
+    def build_model(self, hf_model):
+        from deepspeed_tpu.models.clip import CLIPTextEncoder, clip_text_config
+        hc = hf_model.config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        cfg = clip_text_config(
+            vocab_size=hc.vocab_size,
+            max_position_embeddings=hc.max_position_embeddings,
+            hidden_size=hc.hidden_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            intermediate_size=hc.intermediate_size,
+            ln_eps=hc.layer_norm_eps,
+            activation=_map_activation(hc.hidden_act))
+        pre = "text_model."
+        params = {
+            "wte": sd[pre + "embeddings.token_embedding.weight"],
+            "wpe": sd[pre + "embeddings.position_embedding.weight"],
+            "blocks": _clip_encoder_blocks(sd, pre, cfg.num_hidden_layers),
+            "ln_f_g": sd[pre + "final_layer_norm.weight"],
+            "ln_f_b": sd[pre + "final_layer_norm.bias"],
+        }
+        return CLIPTextEncoder(cfg, eos_token_id=hc.eos_token_id), params
+
+
+class HFCLIPVisionPolicy(InjectionPolicy):
+    """HF CLIPVisionModel: the ViT tower, patch conv flattened to one MXU
+    matmul (``models/clip.py``)."""
+
+    model_types = ("clip_vision_model",)
+
+    def build_model(self, hf_model):
+        from deepspeed_tpu.models.clip import CLIPVisionConfig, CLIPVisionEncoder
+        hc = hf_model.config
+        sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+        cfg = CLIPVisionConfig(
+            image_size=hc.image_size, patch_size=hc.patch_size,
+            hidden_size=hc.hidden_size,
+            num_hidden_layers=hc.num_hidden_layers,
+            num_attention_heads=hc.num_attention_heads,
+            intermediate_size=hc.intermediate_size,
+            ln_eps=hc.layer_norm_eps,
+            activation=_map_activation(hc.hidden_act))
+        pre = "vision_model."
+        patch = sd[pre + "embeddings.patch_embedding.weight"]  # [E, C, P, P]
+        # pre_layrnorm: HF's (sic) attribute name for the pre-encoder LN
+        params = {
+            "patch_w": patch.reshape(patch.shape[0], -1).T,   # [C*P*P, E]
+            "class_emb": sd[pre + "embeddings.class_embedding"],
+            "pos_emb": sd[pre + "embeddings.position_embedding.weight"],
+            "pre_ln_g": sd[pre + "pre_layrnorm.weight"],
+            "pre_ln_b": sd[pre + "pre_layrnorm.bias"],
+            "blocks": _clip_encoder_blocks(sd, pre, cfg.num_hidden_layers),
+            "post_ln_g": sd[pre + "post_layernorm.weight"],
+            "post_ln_b": sd[pre + "post_layernorm.bias"],
+        }
+        return CLIPVisionEncoder(cfg), params
+
+
 def _with(cfg, **kw):
     import dataclasses
     return dataclasses.replace(cfg, **kw)
 
 
 _POLICIES = _POLICIES + (HFBloomPolicy, HFLlamaPolicy, HFGPTJPolicy,
-                         HFGPTNeoXPolicy, HFBertPolicy)
+                         HFGPTNeoXPolicy, HFBertPolicy, HFDistilBertPolicy,
+                         HFCLIPTextPolicy, HFCLIPVisionPolicy)

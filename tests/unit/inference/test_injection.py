@@ -6,6 +6,7 @@ Here tiny HF torch models (built offline from configs, random weights) are
 injected into the fused TPU decode path and compared logit-for-logit.
 """
 
+import jax
 import numpy as np
 import pytest
 import torch
@@ -408,4 +409,78 @@ class TestBertInjection:
             ref = tiny_bert(torch.tensor(padded),
                             attention_mask=torch.tensor(mask)).logits
         np.testing.assert_allclose(ours[:, :12, :97], ref.numpy()[:, :12],
+                                   atol=3e-4, rtol=3e-4)
+
+
+class TestDistilBertInjection:
+    """DistilBERT MLM through the fused encoder (no token-type embeddings,
+    separate q/k/v linears concatenated into fused qkv)."""
+
+    @pytest.fixture(scope="class")
+    def tiny_distilbert(self):
+        torch.manual_seed(7)
+        cfg = transformers.DistilBertConfig(
+            vocab_size=97, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+            max_position_embeddings=64)
+        return transformers.DistilBertForMaskedLM(cfg).eval()
+
+    def test_mlm_logits_parity(self, tiny_distilbert, ids):
+        engine = deepspeed_tpu.init_inference(tiny_distilbert, dtype="float32")
+        ours = np.asarray(engine(ids))[:, :, :97]
+        ref = _hf_logits(tiny_distilbert, ids)
+        np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+class TestCLIPInjection:
+    """Both CLIP towers (reference module_inject/containers/clip.py) served
+    as hidden states through init_inference."""
+
+    def test_text_tower_parity(self, ids):
+        torch.manual_seed(8)
+        cfg = transformers.CLIPTextConfig(
+            vocab_size=99, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=32)
+        hf = transformers.CLIPTextModel(cfg).eval()
+        engine = deepspeed_tpu.init_inference(hf, dtype="float32")
+        ours = np.asarray(engine(ids))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).last_hidden_state.float().numpy()
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+    def test_text_pooled_legacy_eos(self):
+        """Legacy configs (eos_token_id=2, the HF default) pool at
+        input_ids.argmax — HF's special case, matched exactly."""
+        torch.manual_seed(10)
+        cfg = transformers.CLIPTextConfig(
+            vocab_size=99, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=32, eos_token_id=2)
+        hf = transformers.CLIPTextModel(cfg).eval()
+        engine = deepspeed_tpu.init_inference(hf, dtype="float32")
+        ids = np.random.default_rng(4).integers(3, 99, (2, 12))
+        pooled = np.asarray(jax.jit(engine.module.pooled)(engine.params, ids))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).pooler_output.float().numpy()
+        np.testing.assert_allclose(pooled, ref, atol=3e-4, rtol=3e-4)
+
+    @pytest.mark.parametrize("act", ["quick_gelu", "gelu"])
+    def test_vision_tower_parity(self, act):
+        torch.manual_seed(9)
+        cfg = transformers.CLIPVisionConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=64, image_size=32, patch_size=8,
+            hidden_act=act)
+        hf = transformers.CLIPVisionModel(cfg).eval()
+        engine = deepspeed_tpu.init_inference(hf, dtype="float32")
+        rng = np.random.default_rng(3)
+        pixels = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+        ours = np.asarray(engine(pixels))
+        with torch.no_grad():
+            out = hf(torch.tensor(pixels))
+        ref = out.last_hidden_state.float().numpy()
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+        # pooled = post-LN CLS row (HF pooler_output)
+        pooled = np.asarray(jax.jit(engine.module.pooled)(engine.params, pixels))
+        np.testing.assert_allclose(pooled, out.pooler_output.float().numpy(),
                                    atol=3e-4, rtol=3e-4)

@@ -1,4 +1,4 @@
-"""Small-op parity: random-LTD dropping utils, spatial bias ops, the fused
+"""Small-op parity: random-LTD dropping utils, the fused
 transformer layer surface, activation-checkpointing policy mapping."""
 
 import numpy as np
@@ -28,21 +28,6 @@ class TestDroppingUtils:
         idx, sliced = bert_sample_tokens(8, 32, 2, layers=2,
                                          rng=jax.random.key(1), attn_mask=mask)
         assert sliced.shape == (2, 2, 8)
-
-
-class TestSpatialOps:
-    def test_bias_add_variants(self):
-        from deepspeed_tpu.ops.spatial import (nhwc_bias_add,
-                                               nhwc_bias_add_add,
-                                               nhwc_bias_add_bias_add)
-        a = jnp.ones((2, 4, 4, 8))
-        b = jnp.arange(8, dtype=jnp.float32)
-        o = nhwc_bias_add(a, b)
-        np.testing.assert_allclose(np.asarray(o)[0, 0, 0], 1 + np.arange(8))
-        o2 = nhwc_bias_add_add(a, b, a)
-        np.testing.assert_allclose(np.asarray(o2)[0, 0, 0], 2 + np.arange(8))
-        o3 = nhwc_bias_add_bias_add(a, b, a, b)
-        np.testing.assert_allclose(np.asarray(o3)[0, 0, 0], 2 + 2 * np.arange(8))
 
 
 class TestTransformerLayer:
