@@ -1,0 +1,109 @@
+"""``dst-ckpt`` — offline checkpoint tooling (no engine, no TPU needed).
+
+Reference: ``deepspeed/utils/zero_to_fp32.py:158`` (the standalone fp32
+exporter dropped into every checkpoint dir) and
+``deepspeed/checkpoint/deepspeed_checkpoint.py:33`` (the reshape/inspect
+helper that reads checkpoint structure without a live cluster).
+
+Subcommands::
+
+    dst-ckpt export  <ckpt_dir> <out.npz|out.pt> [--tag TAG]
+    dst-ckpt inspect <ckpt_dir> [--tag TAG]
+
+``export`` consolidates the (sharded, any-ZeRO-stage) saved params into a
+flat fp32 state dict — byte-identical to a live ``engine.get_fp32_params()``
+walk, because TPU checkpoints store one logical orbax tree and tensorstore
+reassembles shards on host restore.  ``inspect`` prints tags, training
+metadata, and the parameter tree (name/shape/dtype + totals).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from deepspeed_tpu.utils.zero_to_fp32 import (
+    convert_zero_checkpoint_to_fp32_state_dict, resolve_tag)
+
+
+def cmd_export(args) -> int:
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, args.tag)
+    return 0
+
+
+def _param_metadata(state_path: str):
+    """{flat_name: (shape, dtype)} for the params subtree, METADATA ONLY —
+    no tensor bytes are read, so inspecting a multi-hundred-GB training
+    checkpoint works on any laptop."""
+    import orbax.checkpoint as ocp
+    meta = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).metadata(state_path)
+    # StepMetadata -> TreeMetadata -> raw tree of ArrayMetadata leaves
+    tree = getattr(meta, "item_metadata", meta)
+    tree = getattr(tree, "tree", tree)
+    if isinstance(tree, dict) and "params" in tree:
+        tree = tree["params"]
+    out = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}{k}.")
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{prefix}{i}.")
+        else:
+            shape = tuple(getattr(node, "shape", ()) or ())
+            dtype = getattr(node, "dtype", None)
+            out[prefix[:-1]] = (shape, dtype)
+
+    walk(tree, "")
+    return out
+
+
+def cmd_inspect(args) -> int:
+    ckpt_dir = args.checkpoint_dir
+    tags = sorted(d for d in os.listdir(ckpt_dir)
+                  if os.path.isdir(os.path.join(ckpt_dir, d)))
+    tag = resolve_tag(ckpt_dir, args.tag)
+    print(f"checkpoint dir: {ckpt_dir}")
+    print(f"tags: {', '.join(tags) or '(none)'}   [inspecting: {tag}]")
+    meta_path = os.path.join(ckpt_dir, tag, "client_state.json")
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        for key in ("global_steps", "global_samples", "micro_steps",
+                    "zero_stage", "world_size", "mesh_shape"):
+            if key in meta:
+                print(f"  {key}: {meta[key]}")
+    params = _param_metadata(os.path.join(ckpt_dir, tag, "state"))
+    total = 0
+    import numpy as np
+    for name in sorted(params):
+        shape, dtype = params[name]
+        n = int(np.prod(shape)) if shape else 1
+        total += n
+        print(f"  {name:60s} {str(shape):24s} {dtype}")
+    print(f"  -- {len(params)} tensors, {total:,} parameters "
+          f"({total * 4 / 2**20:.1f} MiB fp32)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="dst-ckpt", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_exp = sub.add_parser("export", help="consolidate to fp32 npz/pt")
+    p_exp.add_argument("checkpoint_dir")
+    p_exp.add_argument("output_file")
+    p_exp.add_argument("--tag", default=None)
+    p_exp.set_defaults(fn=cmd_export)
+    p_ins = sub.add_parser("inspect", help="print tags/metadata/param tree")
+    p_ins.add_argument("checkpoint_dir")
+    p_ins.add_argument("--tag", default=None)
+    p_ins.set_defaults(fn=cmd_inspect)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

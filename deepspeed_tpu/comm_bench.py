@@ -63,7 +63,13 @@ def run_bench(ops, sizes_mb, trials, devices=None):
                     return jax.lax.psum(v, "x") / n
                 if op == "allgather":
                     g = jax.lax.all_gather(v, "x")        # [n, ...]
-                    return g[jax.lax.axis_index("x")]
+                    # consume EVERY gathered shard with device-dependent
+                    # weights: indexing only g[axis_index] is legally
+                    # simplified back to the input by XLA, eliding the
+                    # collective and making the bandwidth number fiction
+                    w = (jax.lax.axis_index("x") + 1 + jnp.arange(n)
+                         ).astype(v.dtype)
+                    return jnp.tensordot(w, g, axes=(0, 0)) / n
                 if op == "reducescatter":
                     # scatter over the flattened payload, zero-padded to a
                     # multiple of n, then tile back so the chain's shapes

@@ -219,8 +219,6 @@ def bert_encode(cfg: BertConfig, params: Dict, input_ids: Array,
     """Hidden states [B, S, E].  ``attention_mask`` [B, S] (1 = real,
     0 = pad, the HF serving convention) becomes an additive key bias so
     pad tokens never receive attention."""
-    from deepspeed_tpu.ops.attention import get_attention_fn
-    attention_fn = attention_fn or get_attention_fn(cfg.attn_impl)
     B, S = input_ids.shape
     dt = cfg.dtype
     with jax.named_scope("embed"):

@@ -62,7 +62,18 @@ def canonical_bias(bias):
 
 
 def reference_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None):
-    """Pure-jnp multi-head attention, fp32 softmax accumulation (GQA-aware)."""
+    """Pure-jnp multi-head attention, fp32 softmax accumulation (GQA-aware).
+
+    ``bias``/``alibi`` are stop-gradiented: the kernel paths (flash, ring)
+    cannot produce an O(S^2) dbias without defeating their memory scaling,
+    so the FRAMEWORK-WIDE contract (see ``get_attention_fn``) is that both
+    bias forms are constants under differentiation — the reference path
+    must agree or a learned bias would silently train only when dispatch
+    happened to select it."""
+    if bias is not None:
+        bias = jax.lax.stop_gradient(bias)
+    if alibi is not None:
+        alibi = jax.lax.stop_gradient(alibi)
     k, v = expand_kv_heads(q, k, v)
     B, S, H, D = q.shape
     Sk = k.shape[1]
@@ -161,6 +172,15 @@ _REGISTRY = {
 
 
 def get_attention_fn(impl: str = "auto") -> Callable:
+    """Resolve an attention impl by name.
+
+    Contract (ALL impls): ``fn(q, k, v, *, causal, bias=None, alibi=None)``
+    with [batch, seq, heads, head_dim]; ``bias`` and ``alibi`` are
+    CONSTANTS under differentiation on every path (gradients flow to
+    q/k/v only) — a learned T5-style bias is not supported, by design:
+    its O(S^2) dbias would defeat the flash/ring memory scaling, and the
+    jnp reference path stop-gradients to keep dispatch-invariant
+    semantics."""
     assert impl in _REGISTRY, f"unknown attention impl {impl!r}; have {list(_REGISTRY)}"
     return _REGISTRY[impl]
 

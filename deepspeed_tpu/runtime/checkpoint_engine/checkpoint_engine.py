@@ -91,7 +91,16 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         import orbax.checkpoint as ocp
         ckpt = ocp.PyTreeCheckpointer()
         if target is not None:
-            return ckpt.restore(path, item=target)
+            # explicit per-leaf restore_args from the TARGET's shardings:
+            # without them orbax either warns "Sharding info not provided
+            # when restoring" (item= kwarg) or reassembles onto the mesh
+            # recorded AT SAVE TIME (its sharding metadata file) — both
+            # wrong when restoring on a different topology.  With them,
+            # every leaf is read straight into its new sharding, which is
+            # what makes save-on-8 / load-on-4 (elastic resize) safe.
+            restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+            return ckpt.restore(path, args=ocp.args.PyTreeRestore(
+                item=target, restore_args=restore_args))
         return ckpt.restore(path)
 
     def commit(self, tag: str) -> bool:

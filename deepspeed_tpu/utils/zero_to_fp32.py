@@ -19,7 +19,27 @@ importable and the output path ends with .pt).
 
 import argparse
 import os
+import re
 import sys
+
+
+def resolve_tag(checkpoint_dir: str, tag=None) -> str:
+    """The tag to read: explicit > the 'latest' pointer > newest dir by
+    NATURAL sort (global_step10 beats global_step9 — a plain lexicographic
+    sort gets that backwards)."""
+    if tag is not None:
+        return str(tag)
+    latest = os.path.join(checkpoint_dir, "latest")
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    tags = [d for d in os.listdir(checkpoint_dir)
+            if os.path.isdir(os.path.join(checkpoint_dir, d))]
+    if not tags:
+        raise FileNotFoundError(f"no checkpoints under {checkpoint_dir}")
+    natural = lambda s: [int(p) if p.isdigit() else p
+                         for p in re.split(r"(\d+)", s)]
+    return max(tags, key=natural)
 
 
 def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
@@ -28,16 +48,7 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
     import numpy as np
     import orbax.checkpoint as ocp
 
-    if tag is None:
-        latest = os.path.join(checkpoint_dir, "latest")
-        if os.path.isfile(latest):
-            with open(latest) as f:
-                tag = f.read().strip()
-        else:
-            tags = sorted(d for d in os.listdir(checkpoint_dir)
-                          if os.path.isdir(os.path.join(checkpoint_dir, d)))
-            assert tags, f"no checkpoints under {checkpoint_dir}"
-            tag = tags[-1]
+    tag = resolve_tag(checkpoint_dir, tag)
     state_path = os.path.join(checkpoint_dir, str(tag), "state")
     assert os.path.isdir(state_path), f"no checkpoint state at {state_path}"
 

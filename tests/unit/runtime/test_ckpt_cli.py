@@ -1,0 +1,58 @@
+import numpy as np
+import pytest
+
+
+def test_export_matches_live_engine(tmp_path):
+    """dst-ckpt export on a saved ZeRO-2 checkpoint equals the live
+    engine's get_fp32_params consolidation (VERDICT r4 #9)."""
+    import jax, jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+    from deepspeed_tpu.ckpt_cli import main as ckpt_main
+    cfg = gpt_config("tiny", n_embd=32, n_head=2, n_layer=2, vocab_size=128,
+                     n_positions=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT(cfg), config={
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+    })
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8, 32), 0, 128)
+    engine.train_batch(batch=(ids, ids))
+    engine.save_checkpoint(str(tmp_path / "ck"))
+
+    out = tmp_path / "weights.npz"
+    rc = ckpt_main(["export", str(tmp_path / "ck"), str(out)])
+    assert rc == 0 and out.exists()
+    exported = dict(np.load(out))
+
+    live = {}
+    def walk(node, prefix=""):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}{k}.")
+        else:
+            live[prefix[:-1]] = np.asarray(node, np.float32)
+    walk(jax.device_get(engine.get_fp32_params()))
+    assert set(exported) == set(live), (set(exported) ^ set(live))
+    for k in live:
+        np.testing.assert_array_equal(exported[k], live[k], err_msg=k)
+
+
+def test_inspect_prints_tree(tmp_path, capsys):
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+    from deepspeed_tpu.ckpt_cli import main as ckpt_main
+    cfg = gpt_config("tiny", n_embd=32, n_head=2, n_layer=2, vocab_size=128,
+                     n_positions=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT(cfg), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+    })
+    engine.save_checkpoint(str(tmp_path / "ck"), tag="step0")
+    rc = ckpt_main(["inspect", str(tmp_path / "ck")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "step0" in out and "wte" in out and "parameters" in out
+    assert "zero_stage" in out and "mesh_shape" in out
