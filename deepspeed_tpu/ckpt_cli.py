@@ -23,7 +23,7 @@ import os
 import sys
 
 from deepspeed_tpu.utils.zero_to_fp32 import (
-    convert_zero_checkpoint_to_fp32_state_dict, resolve_tag)
+    convert_zero_checkpoint_to_fp32_state_dict, flatten_tree, resolve_tag)
 
 
 def cmd_export(args) -> int:
@@ -43,22 +43,9 @@ def _param_metadata(state_path: str):
     tree = getattr(tree, "tree", tree)
     if isinstance(tree, dict) and "params" in tree:
         tree = tree["params"]
-    out = {}
-
-    def walk(node, prefix):
-        if isinstance(node, dict):
-            for k, v in node.items():
-                walk(v, f"{prefix}{k}.")
-        elif isinstance(node, (list, tuple)):
-            for i, v in enumerate(node):
-                walk(v, f"{prefix}{i}.")
-        else:
-            shape = tuple(getattr(node, "shape", ()) or ())
-            dtype = getattr(node, "dtype", None)
-            out[prefix[:-1]] = (shape, dtype)
-
-    walk(tree, "")
-    return out
+    return {name: (tuple(getattr(m, "shape", ()) or ()),
+                   getattr(m, "dtype", None))
+            for name, m in flatten_tree(tree).items()}
 
 
 def cmd_inspect(args) -> int:

@@ -1,0 +1,77 @@
+"""`dst-ssh` / `dst-elastic` — the reference's `bin/` utility belt.
+
+Reference: ``bin/ds_ssh`` (run a command on every hostfile host via pdsh)
+and ``bin/ds_elastic`` (query the elastic batch/GPU solver for a config).
+TPU-native differences: ``dst-ssh`` shells out to plain ``ssh`` with a
+thread per host (pdsh is rarely present on TPU-VM images; the launcher's
+pdsh path remains for pods that have it), and ``dst-elastic`` prints the
+same solver results from ``deepspeed_tpu.elasticity``.
+"""
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+
+def dst_ssh_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dst-ssh", description="run a command on every hostfile host")
+    parser.add_argument("-f", "--hostfile", default="/job/hostfile")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on each host")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    from deepspeed_tpu.launcher.runner import fetch_hostfile
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        print(f"no hosts in {args.hostfile}", file=sys.stderr)
+        return 1
+    cmd = shlex.join(args.command)   # preserve arg quoting remotely
+
+    def run(host):
+        p = subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host, cmd],
+            capture_output=True, text=True)
+        return host, p.returncode, p.stdout, p.stderr
+
+    rc = 0
+    with ThreadPoolExecutor(max_workers=min(32, len(resources))) as pool:
+        for host, code, out, err in pool.map(run, resources):
+            for line in out.splitlines():
+                print(f"{host}: {line}")
+            for line in err.splitlines():
+                print(f"{host}: {line}", file=sys.stderr)
+            rc = rc or code
+    return rc
+
+
+def dst_elastic_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dst-elastic", description="query the elastic batch solver")
+    parser.add_argument("-c", "--config", required=True)
+    parser.add_argument("-w", "--world-size", type=int, default=0)
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    from deepspeed_tpu.version import __version__
+    print("Elasticity config:")
+    print(json.dumps(ds_config.get("elasticity", {}), indent=4,
+                     sort_keys=True))
+    if args.world_size > 0:
+        batch, gpus, micro = compute_elastic_config(
+            ds_config, target_deepspeed_version=__version__,
+            world_size=args.world_size, return_microbatch=True)
+        print(f"final_batch_size .... {batch}")
+        print(f"valid_gpus .......... {gpus}")
+        print(f"micro_batch_size .... {micro}")
+    else:
+        batch, gpus = compute_elastic_config(
+            ds_config, target_deepspeed_version=__version__)
+        print(f"final_batch_size .... {batch}")
+        print(f"valid_gpus .......... {gpus}")
+    return 0

@@ -6,9 +6,14 @@ from deepspeed_tpu.compression.basic_ops import (apply_head_mask,
 from deepspeed_tpu.compression.compress import (CompressionSpec,
                                                 init_compression,
                                                 redundancy_clean)
+from deepspeed_tpu.compression.layer_reduction import (apply_layer_reduction,
+                                                       student_initialization,
+                                                       student_model_config)
 from deepspeed_tpu.compression.scheduler import CompressionScheduler
 
 __all__ = ["quantize_weight", "quantize_activation", "sparse_mask",
            "row_mask", "channel_mask", "head_mask", "apply_row_mask",
            "apply_head_mask", "init_compression", "redundancy_clean",
-           "CompressionSpec", "CompressionScheduler"]
+           "CompressionSpec", "CompressionScheduler",
+           "apply_layer_reduction", "student_initialization",
+           "student_model_config"]

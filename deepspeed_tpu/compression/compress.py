@@ -9,6 +9,15 @@ redesign: ``init_compression`` builds a :class:`CompressionSpec` mapping
 param-tree leaf paths (regex, the module-name analogue) to techniques;
 ``spec.transform(params, step, rng)`` is a pure function the engine's
 train step jits; ``redundancy_clean`` returns a smaller pytree.
+
+TP composition: the reference needs TP-aware compressed-layer variants
+(``basic_layer.py:611,767,802`` — LinearLayer_Compress forks for row/
+column parallelism) because its masks live inside sharded torch modules.
+Here the transform runs on the LOGICAL param tree inside the jitted step,
+BEFORE GSPMD partitions anything: masks/quantization shard exactly like
+the weights they wrap, so every technique is TP/ZeRO-safe with zero extra
+code.  ``layer_reduction`` (student distillation init) lives in
+``layer_reduction.py``.
 """
 
 import re

@@ -1,0 +1,103 @@
+"""Layer reduction + distillation initialization (KD student setup).
+
+Reference: ``deepspeed/compression/compress.py:167``
+(``student_initialization``): given a trained TEACHER, build a shallower
+STUDENT whose layer ``s`` starts from teacher layer ``teacher_layer[s]``
+and whose embeddings/head (``other_module_name``) copy over — the
+TinyBERT/MiniLM-style task-agnostic distillation recipe.
+
+Config block (reference ``compression/constants.py``)::
+
+    "compression_training": {
+      "layer_reduction": {
+        "enabled": true,
+        "keep_number_layer": 6,
+        "teacher_layer": [1, 3, 5, 7, 9, 11],
+        "module_name_prefix": "blocks",      # param-tree analogue
+        "other_module_name": ["wte", "wpe"]  # informational: non-block
+      }                                      # leaves ALWAYS copy here
+    }
+
+TPU-native: models stack layers as ``[L, ...]`` scan leaves, so selecting
+teacher layers is ONE gather per leaf (``leaf[teacher_layer]``) instead of
+the reference's per-module ``recursive_getattr`` + ``copy.deepcopy`` walk.
+Non-scan ``h{i}`` dicts are re-keyed.  The caller passes the teacher's
+param tree and model config; back comes the student's — the functional
+equivalent of mutating the student model in place.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def layer_reduction_config(ds_config: Dict) -> Optional[Dict]:
+    """The enabled ``layer_reduction`` block, or None."""
+    cfg = (ds_config.get("compression_training", ds_config) or {})
+    lr = cfg.get("layer_reduction", {}) or {}
+    return lr if lr.get("enabled", False) else None
+
+
+def _select_layers(blocks, teacher_layer: List[int], prefix: str):
+    if isinstance(blocks, dict) and any(k.startswith("h") and k[1:].isdigit()
+                                        for k in blocks):
+        # non-scan layout: h{teacher_layer[s]} -> h{s}
+        return {f"h{s}": blocks[f"h{t}"] for s, t in enumerate(teacher_layer)}
+    # scan layout: every leaf carries a leading [L] dim — one gather.
+    # Bounds-check eagerly: jax gather CLAMPS out-of-range indices, which
+    # would silently distill from the wrong teacher layer.
+    L = int(jax.tree.leaves(blocks)[0].shape[0])
+    assert all(0 <= t < L for t in teacher_layer), (
+        f"teacher_layer {teacher_layer} out of range for {L} teacher layers")
+    idx = jnp.asarray(teacher_layer)
+    return jax.tree.map(lambda a: a[idx], blocks)
+
+
+def student_initialization(teacher_params: Dict, ds_config: Dict,
+                           blocks_key: Optional[str] = None) -> Dict:
+    """Student params from teacher params per the layer_reduction block
+    (reference ``student_initialization:184``).  Every non-block leaf
+    (embeddings, final LN, head — the reference's ``other_module_name``)
+    is copied as-is; the block stack keeps only ``teacher_layer``."""
+    lr = layer_reduction_config(ds_config)
+    assert lr is not None, "layer_reduction not enabled in config"
+    teacher_layer = list(lr["teacher_layer"])
+    keep = int(lr.get("keep_number_layer", len(teacher_layer)))
+    assert len(teacher_layer) == keep, (
+        f"teacher_layer has {len(teacher_layer)} entries but "
+        f"keep_number_layer={keep} (reference asserts the same match)")
+    blocks_key = blocks_key or lr.get("module_name_prefix", "blocks")
+    assert blocks_key in teacher_params, (
+        f"param tree has no {blocks_key!r} stack; keys: "
+        f"{list(teacher_params)}")
+    student = dict(teacher_params)
+    student[blocks_key] = _select_layers(teacher_params[blocks_key],
+                                         teacher_layer, blocks_key)
+    log_dist(f"layer_reduction: student keeps teacher layers "
+             f"{teacher_layer}", ranks=[0])
+    return student
+
+
+def student_model_config(model_cfg: Any, ds_config: Dict) -> Any:
+    """The student's model config: same architecture, ``keep_number_layer``
+    layers (works for GPTConfig.n_layer and BertConfig.num_hidden_layers)."""
+    lr = layer_reduction_config(ds_config)
+    assert lr is not None, "layer_reduction not enabled in config"
+    keep = int(lr.get("keep_number_layer", len(lr["teacher_layer"])))
+    for field in ("n_layer", "num_hidden_layers"):
+        if hasattr(model_cfg, field):
+            return dataclasses.replace(model_cfg, **{field: keep})
+    raise ValueError(f"model config {type(model_cfg).__name__} has no "
+                     "layer-count field (n_layer / num_hidden_layers)")
+
+
+def apply_layer_reduction(model_cfg: Any, teacher_params: Dict,
+                          ds_config: Dict) -> Tuple[Any, Dict]:
+    """(student_cfg, student_params) in one call — the functional
+    analogue of the reference's in-place student mutation."""
+    return (student_model_config(model_cfg, ds_config),
+            student_initialization(teacher_params, ds_config))

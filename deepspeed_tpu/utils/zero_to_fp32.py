@@ -42,6 +42,26 @@ def resolve_tag(checkpoint_dir: str, tag=None) -> str:
     return max(tags, key=natural)
 
 
+def flatten_tree(tree) -> dict:
+    """{dotted_name: leaf} for a nested dict/list tree — the ONE naming
+    scheme shared by export (values = arrays) and inspect (values =
+    orbax ArrayMetadata)."""
+    out = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}{k}.")
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{prefix}{i}.")
+        else:
+            out[prefix[:-1]] = node
+
+    walk(tree, "")
+    return out
+
+
 def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
                                              tag: str = None) -> dict:
     """Full fp32 {flat_name: np.ndarray} from a saved checkpoint."""
@@ -53,22 +73,8 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
     assert os.path.isdir(state_path), f"no checkpoint state at {state_path}"
 
     restored = ocp.PyTreeCheckpointer().restore(state_path)
-    params = restored["params"]
-
-    out = {}
-
-    def walk(node, prefix):
-        if isinstance(node, dict):
-            for k, v in node.items():
-                walk(v, f"{prefix}{k}." if prefix else f"{k}.")
-        elif isinstance(node, (list, tuple)):
-            for i, v in enumerate(node):
-                walk(v, f"{prefix}{i}.")
-        else:
-            out[prefix[:-1]] = np.asarray(node, np.float32)
-
-    walk(params, "")
-    return out
+    return {name: np.asarray(leaf, np.float32)
+            for name, leaf in flatten_tree(restored["params"]).items()}
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,

@@ -1,0 +1,57 @@
+"""dst-ssh / dst-elastic CLI (reference bin/ds_ssh, bin/ds_elastic)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.cli_utils import dst_elastic_main, dst_ssh_main
+
+
+def test_dst_ssh_runs_on_all_hosts(tmp_path, monkeypatch, capsys):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=4\nworker-1 slots=4\n")
+    calls = []
+
+    class P:
+        returncode = 0
+        stdout = "ok\n"
+        stderr = ""
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return P()
+
+    monkeypatch.setattr("subprocess.run", fake_run)
+    rc = dst_ssh_main(["-f", str(hostfile), "hostname"])
+    assert rc == 0
+    assert len(calls) == 2
+    assert all(c[0] == "ssh" and c[-1] == "hostname" for c in calls)
+    hosts = {c[-2] for c in calls}
+    assert hosts == {"worker-0", "worker-1"}
+    out = capsys.readouterr().out
+    assert "worker-0: ok" in out and "worker-1: ok" in out
+
+
+def test_dst_ssh_propagates_failure(tmp_path, monkeypatch):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=1\n")
+
+    class P:
+        returncode = 7
+        stdout = ""
+        stderr = "boom\n"
+
+    monkeypatch.setattr("subprocess.run", lambda *a, **k: P())
+    assert dst_ssh_main(["-f", str(hostfile), "false"]) == 7
+
+
+def test_dst_elastic_prints_solution(tmp_path, capsys):
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 256,
+                          "micro_batch_sizes": [2, 4, 8], "min_gpus": 1,
+                          "max_gpus": 64, "min_time": 0, "version": 0.1,
+                          "ignore_non_elastic_batch_info": True}}
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps(cfg))
+    assert dst_elastic_main(["-c", str(p), "-w", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "final_batch_size" in out and "micro_batch_size" in out
