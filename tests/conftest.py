@@ -26,7 +26,14 @@ jax.config.update("jax_platforms", "cpu")
 # (+prefer-no-gather etc.) fail to match at reload in a fresh process on
 # this very machine — and the failed load SILENTLY yields zero-filled
 # outputs (observed: a checkpoint round-trip restoring all-zeros params).
-# Suite speed comes from shared fixtures instead.
+#
+# Suite wall-clock accounting (r5, this CI: ONE cpu core, so xdist cannot
+# help either): ~24 min for ~355 tests, dominated by serial XLA compiles
+# of per-test programs plus two real-TPU subprocess parity checks
+# (test_{flash,sparse}_attention_tpu.py, ~2 min — the on-hardware kernel
+# validation, deliberately kept).  Known fixed sinks: a re-jit-per-call
+# loop in the onebit convergence test (184s -> 4s) and duplicate ZeRO
+# memory-proof compiles (now memoized).
 
 assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
 

@@ -66,11 +66,26 @@ class TestCompressedAllreduce:
         exact = xs.mean(0)
         we, se = init_compression_state(n, 8)
         WE, SE = np.tile(we, (8, 1)), np.tile(se, (8, 1))
-        acc = np.zeros(n)
         iters = 300
-        for _ in range(iters):
-            out, WE, SE = _run(xs, WE, SE, mesh)
-            acc += np.asarray(out)[0]
+
+        # the whole error-feedback loop as ONE scanned program (the
+        # python-loop version re-dispatched 300 times on one CPU core)
+        def f(x, we, se):
+            def step(carry, _):
+                we, se, acc = carry
+                out, st = compressed_allreduce(x[0],
+                                               CompressionState(we, se),
+                                               "data")
+                return (st.worker_error, st.server_error, acc + out), None
+
+            init = (we[0], se[0], jnp.zeros_like(x[0]))
+            (_, _, acc), _ = jax.lax.scan(step, init, None, length=iters)
+            return acc[None]
+
+        g = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+            out_specs=P("data"), check_vma=False))
+        acc = np.asarray(g(xs, WE, SE))[0]
         err = np.abs(acc / iters - exact).max() / (np.abs(exact).max() + 1e-9)
         assert err < 0.05            # compensated compression is unbiased
 

@@ -19,7 +19,22 @@ from deepspeed_tpu.models.gpt import GPT, gpt_config
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_step_memory_cached(stage, extra_zero_items, micro):
+    return _fused_step_memory_impl(stage, dict(extra_zero_items or ()), micro)
+
+
 def _fused_step_memory(stage, extra_zero=None, micro=8):
+    """Memoized across tests: the stage-1/3 compiles are shared between
+    the ordering and threshold tests (each costs ~10s on the 1-core CI)."""
+    items = tuple(sorted((extra_zero or {}).items()))
+    return _fused_step_memory_cached(stage, items, micro)
+
+
+def _fused_step_memory_impl(stage, extra_zero=None, micro=8):
     mesh_lib.reset_mesh()
     cfg = gpt_config("tiny", n_embd=256, n_head=4, n_layer=4, vocab_size=2048,
                      n_positions=128, attn_impl="reference")
