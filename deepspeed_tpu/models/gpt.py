@@ -763,6 +763,18 @@ def _cached_attention(q, ck, cv, pos, bias=None):
     ``bias``: additive [1, H, S_q, T] logit bias (ALiBi)."""
     B, Sq, H, D = q.shape
     T, Hkv = ck.shape[1], ck.shape[2]
+    if bias is None and Hkv == H and os.environ.get("DST_PALLAS_DECODE") == "1":
+        # OPT-IN (r5): the Pallas decode kernel DMAs only the pos+Sq valid
+        # cache blocks and fuses score/softmax/PV — profiling shows the
+        # einsum below is ~45% of per-token decode time, so this is the
+        # right shape of fix — but its data-dependent DMA loop DEADLOCKED
+        # the v5e on first hardware run (the r4 kernel never ran on
+        # hardware either: Mosaic rejected its H-dim slicing at compile).
+        # CPU-interpret parity is green (tests/unit/ops/
+        # test_decode_attention.py); kept off the default path until the
+        # hardware hang is root-caused on a chip that can be safely wedged.
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+        return decode_attention(q, ck, cv, pos)
     G = H // Hkv
     scale = 1.0 / np.sqrt(D)
     qg = q.reshape(B, Sq, Hkv, G, D)

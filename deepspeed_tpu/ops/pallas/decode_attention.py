@@ -41,73 +41,81 @@ def _interpret() -> bool:
 
 
 def _decode_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
-                   sem_k, sem_v, *, scale, bk, Sq):
+                   sem_k, sem_v, *, scale, bk, Sq, H):
+    """Grid (B,): ONE [bk, H, D] DMA per cache block serves every head
+    (batched dot_general over the head dim) — the per-(b, h) grid of the
+    round-4 kernel both re-streamed the cache H times and sliced the
+    tiled H dim to 1, which Mosaic rejects on hardware."""
     b = pl.program_id(0)
-    h = pl.program_id(1)
     pos = pos_ref[0]
-    q = q_ref[0, 0].astype(jnp.float32)          # [Sq, D]
-    D = q.shape[-1]
-    nk = (pos + Sq + bk - 1) // bk               # data-dependent trip count
+    q = q_ref[0]                                  # [Sq, H, D], storage dtype
+    nk = (pos + Sq + bk - 1) // bk                # data-dependent trip count
 
     def body(j, carry):
-        m, l, acc = carry
-        cp_k = pltpu.make_async_copy(k_hbm.at[b, pl.ds(j * bk, bk), h, :], k_buf, sem_k)
-        cp_v = pltpu.make_async_copy(v_hbm.at[b, pl.ds(j * bk, bk), h, :], v_buf, sem_v)
+        m, l, acc = carry                         # [H,Sq,1] [H,Sq,1] [H,Sq,D]
+        cp_k = pltpu.make_async_copy(k_hbm.at[b, pl.ds(j * bk, bk), :, :],
+                                     k_buf, sem_k)
+        cp_v = pltpu.make_async_copy(v_hbm.at[b, pl.ds(j * bk, bk), :, :],
+                                     v_buf, sem_v)
         cp_k.start()
         cp_v.start()
         cp_k.wait()
         cp_v.wait()
-        k = k_buf[...].astype(jnp.float32)       # [bk, D]
-        v = v_buf[...].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale  # [Sq, bk]
-        rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 0)      # query offset
+        k = k_buf[...]                            # [bk, H, D]
+        v = v_buf[...]
+        # batch over H (axis 1 of both operands), contract D: [H, Sq, bk];
+        # bf16 MXU operands with fp32 accumulation
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((1,), (1,))),
+                                preferred_element_type=jnp.float32) * scale
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 0)
         cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 1)
-        s = jnp.where(cols <= pos + rows, s, NEG_INF)                # causal vs cache
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        s = jnp.where((cols <= pos + rows)[None], s, NEG_INF)   # causal
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                                                preferred_element_type=jnp.float32)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # batch H (p axis 0 / v axis 1), contract bk: [H, Sq, D]
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    m0 = jnp.full((Sq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((Sq, 1), jnp.float32)
-    a0 = jnp.zeros((Sq, D), jnp.float32)
+    D = q.shape[-1]
+    m0 = jnp.full((H, Sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, Sq, 1), jnp.float32)
+    a0 = jnp.zeros((H, Sq, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    out = acc / jnp.maximum(l, 1e-30)             # [H, Sq, D]
+    o_ref[0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
 
 
 def _decode_call(q, ck, cv, pos, *, bk):
     """q [B,Sq,H,D], cache [B,T,H,D], pos scalar → out [B,Sq,H,D]."""
     B, Sq, H, D = q.shape
-    T = ck.shape[1]
     scale = 1.0 / np.sqrt(D)
-    qt = q.transpose(0, 2, 1, 3)                 # [B,H,Sq,D]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(B, H),
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, 1, Sq, D), lambda b, h, pos_ref: (b, h, 0, 0)),
+            pl.BlockSpec((1, Sq, H, D), lambda b, pos_ref: (b, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, Sq, D), lambda b, h, pos_ref: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, Sq, H, D), lambda b, pos_ref: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((bk, D), ck.dtype),
-            pltpu.VMEM((bk, D), cv.dtype),
+            pltpu.VMEM((bk, H, D), ck.dtype),
+            pltpu.VMEM((bk, H, D), cv.dtype),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bk=bk, Sq=Sq),
+        functools.partial(_decode_kernel, scale=scale, bk=bk, Sq=Sq, H=H),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Sq, H, D), q.dtype),
         interpret=_interpret(),
-    )(jnp.asarray(pos, jnp.int32).reshape(1), qt, ck, cv)
-    return out.transpose(0, 2, 1, 3)
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, ck, cv)
+    return out
 
 
 def decode_attention_reference(q, ck, cv, pos):
