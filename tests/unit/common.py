@@ -20,11 +20,28 @@ _FORCED_BACKEND_ENVS = ("JAX_PLATFORMS", "XLA_FLAGS", "_GRAFT_DRYRUN_CHILD")
 
 def run_tpu_tool(tool_name: str, timeout: int = 600):
     """Run ``tools/<tool_name>`` with a clean backend env; assert rc 0 and
-    pytest.skip when the tool reports no TPU attached."""
+    pytest.skip when the tool reports no TPU attached.
+
+    The tools print ``DEVICES_OK`` right after ``jax.devices()`` succeeds.
+    On timeout, its absence distinguishes a device CLAIM that never
+    completed (remote pool/tunnel unavailable or wedged — an infra state,
+    skip) from a kernel/tool hang AFTER the claim (a real failure)."""
     env = {k: v for k, v in os.environ.items() if k not in _FORCED_BACKEND_ENVS}
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO_ROOT, "tools", tool_name)],
-        env=env, capture_output=True, text=True, timeout=timeout)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", tool_name)],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        def txt(b):
+            return (b.decode(errors="replace") if isinstance(b, bytes)
+                    else (b or ""))
+        partial = txt(e.output)
+        if "DEVICES_OK" not in partial:
+            pytest.skip(f"{tool_name}: TPU claim never completed in "
+                        f"{timeout}s (pool/tunnel unavailable)")
+        raise AssertionError(
+            f"{tool_name} hung AFTER acquiring the TPU (kernel/tool hang):\n"
+            f"{partial}\n{txt(e.stderr)}") from e
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"{tool_name} child failed:\n{out}"
     if "SKIP" in proc.stdout:

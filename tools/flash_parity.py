@@ -22,6 +22,7 @@ def main() -> int:
     if jax.devices()[0].platform != "tpu":
         print("SKIP: no TPU attached")
         return 0
+    print("DEVICES_OK", flush=True)   # claim completed (see run_tpu_tool)
 
     from deepspeed_tpu.ops.attention import reference_attention
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention

@@ -21,6 +21,7 @@ def main() -> int:
     if jax.devices()[0].platform != "tpu":
         print("SKIP: no TPU attached")
         return 0
+    print("DEVICES_OK", flush=True)   # claim completed (see run_tpu_tool)
 
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt import GPT, gpt_config

@@ -19,6 +19,7 @@ def main() -> int:
     if jax.devices()[0].platform != "tpu":
         print("SKIP: no TPU attached")
         return 0
+    print("DEVICES_OK", flush=True)   # claim completed (see run_tpu_tool)
 
     from deepspeed_tpu.ops.pallas.block_sparse_attention import (
         block_sparse_attention, sparse_reference_attention)
