@@ -247,7 +247,29 @@ def _detail_path():
     return os.path.join(here, f"BENCH_DETAIL_r{max(rounds, default=0) + 1:02d}.json")
 
 
+def _probe_backend(timeout_s: int = 240) -> bool:
+    """Touch ``jax.devices()`` in a CHILD process first: a wedged remote
+    TPU pool hangs the claim indefinitely inside a C call, which no
+    in-process timeout can interrupt — probing in a subprocess turns an
+    unbounded hang into a bounded, parseable failure for the driver."""
+    import subprocess
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    if not _probe_backend():
+        print(json.dumps({
+            "metric": "BACKEND UNAVAILABLE",
+            "error": "jax.devices() did not complete in 240s — remote TPU "
+                     "pool/tunnel unreachable or wedged; see "
+                     "BENCH_DETAIL_r*.json for the last captured numbers"}))
+        sys.exit(2)
     mode = os.environ.get("BENCH_MODE", "all")
     if mode != "all":
         # unknown modes raise (a typo must not silently run the full suite)
