@@ -247,28 +247,34 @@ def _detail_path():
     return os.path.join(here, f"BENCH_DETAIL_r{max(rounds, default=0) + 1:02d}.json")
 
 
-def _probe_backend(timeout_s: int = 240) -> bool:
+def _probe_backend(timeout_s: int = 240):
     """Touch ``jax.devices()`` in a CHILD process first: a wedged remote
     TPU pool hangs the claim indefinitely inside a C call, which no
     in-process timeout can interrupt — probing in a subprocess turns an
-    unbounded hang into a bounded, parseable failure for the driver."""
+    unbounded hang into a bounded, parseable failure for the driver.
+    Returns None on success, else a diagnosis string (timeout vs the
+    child's actual stderr for fast init errors)."""
     import subprocess
     try:
         p = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return p.returncode == 0
+            timeout=timeout_s, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
-        return False
+        return (f"jax.devices() did not complete in {timeout_s}s — remote "
+                "TPU pool/tunnel unreachable or wedged")
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-3:]
+        return f"backend init failed (rc={p.returncode}): " + " | ".join(tail)
+    return None
 
 
 def main():
-    if not _probe_backend():
+    err = _probe_backend()
+    if err is not None:
         print(json.dumps({
             "metric": "BACKEND UNAVAILABLE",
-            "error": "jax.devices() did not complete in 240s — remote TPU "
-                     "pool/tunnel unreachable or wedged; see "
-                     "BENCH_DETAIL_r*.json for the last captured numbers"}))
+            "error": err + "; see BENCH_DETAIL_r*.json for the last "
+                           "captured numbers"}))
         sys.exit(2)
     mode = os.environ.get("BENCH_MODE", "all")
     if mode != "all":

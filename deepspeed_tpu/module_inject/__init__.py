@@ -16,6 +16,7 @@ parallel PartitionSpecs for the result:
 """
 
 from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.module_inject.megatron import load_megatron_gpt
 from deepspeed_tpu.module_inject.policies import (HFGPT2Policy, HFOPTPolicy,
                                                   HFGPTNeoPolicy,
                                                   InjectionPolicy,
