@@ -7,8 +7,10 @@ anything that must execute on real TPU hardware runs a tool script from
 ``PASS``/``SKIP`` and exit 0; callers skip on SKIP."""
 
 import os
+import select
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -22,28 +24,60 @@ def run_tpu_tool(tool_name: str, timeout: int = 600):
     """Run ``tools/<tool_name>`` with a clean backend env; assert rc 0 and
     pytest.skip when the tool reports no TPU attached.
 
-    The tools print ``DEVICES_OK`` right after ``jax.devices()`` succeeds.
-    On timeout, its absence distinguishes a device CLAIM that never
-    completed (remote pool/tunnel unavailable or wedged — an infra state,
-    skip) from a kernel/tool hang AFTER the claim (a real failure)."""
+    The tools print ``DEVICES_OK`` right after ``jax.devices()`` succeeds
+    (or ``SKIP`` when no TPU is attached).  Two-phase deadline: one of
+    those markers must appear within ``min(240, timeout)`` seconds —
+    healthy claims take seconds, and a wedged remote pool would otherwise
+    burn the full tool timeout PER TEST — after which the tool gets the
+    full ``timeout`` budget for compile + compute.  On expiry, the marker
+    distinguishes a device CLAIM that never completed (infra state →
+    skip) from a kernel/tool hang AFTER acquiring the chip (→ failure).
+    """
     env = {k: v for k, v in os.environ.items() if k not in _FORCED_BACKEND_ENVS}
+    claim_timeout = min(240, timeout)
+    start = time.monotonic()
+    # binary pipes: text-mode streams break under non-blocking reads
+    # (the utf-8 incremental decoder chokes on the no-data None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", tool_name)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    os.set_blocking(proc.stdout.fileno(), False)
+    raw = b""
+    deadline = start + claim_timeout
+    claimed = skip_marker = False
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO_ROOT, "tools", tool_name)],
-            env=env, capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired as e:
-        def txt(b):
-            return (b.decode(errors="replace") if isinstance(b, bytes)
-                    else (b or ""))
-        partial = txt(e.output)
-        if "DEVICES_OK" not in partial:
-            pytest.skip(f"{tool_name}: TPU claim never completed in "
-                        f"{timeout}s (pool/tunnel unavailable)")
-        raise AssertionError(
-            f"{tool_name} hung AFTER acquiring the TPU (kernel/tool hang):\n"
-            f"{partial}\n{txt(e.stderr)}") from e
-    out = proc.stdout + proc.stderr
+        while True:
+            if proc.poll() is not None:
+                raw += proc.stdout.read() or b""
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                proc.kill()
+                proc.wait()
+                raw += proc.stdout.read() or b""   # drain the final flush
+                partial = raw.decode(errors="replace")
+                if claimed and not skip_marker:
+                    raise AssertionError(
+                        f"{tool_name} hung AFTER acquiring the TPU "
+                        f"(kernel/tool hang):\n{partial}")
+                if skip_marker:
+                    pytest.skip("no TPU attached (tool hung in teardown)")
+                pytest.skip(f"{tool_name}: TPU claim never completed in "
+                            f"{claim_timeout}s (pool/tunnel unavailable)")
+            # non-blocking chunk reads gated by select: a silent wedged
+            # claim must not block the deadline check, and marker lines
+            # must be seen even when several arrive in one flush
+            select.select([proc.stdout], [], [], min(remaining, 5.0))
+            raw += proc.stdout.read() or b""
+            if not claimed and (b"DEVICES_OK" in raw or b"SKIP" in raw):
+                claimed = True
+                skip_marker = b"SKIP" in raw
+                deadline = start + timeout   # full budget post-claim
+    finally:
+        proc.stdout.close()
+
+    out = raw.decode(errors="replace")
     assert proc.returncode == 0, f"{tool_name} child failed:\n{out}"
-    if "SKIP" in proc.stdout:
+    if "SKIP" in out:
         pytest.skip("no TPU attached")
-    return proc.stdout
+    return out

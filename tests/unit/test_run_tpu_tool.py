@@ -1,0 +1,61 @@
+"""run_tpu_tool harness behavior (the DEVICES_OK two-phase deadline),
+exercised with synthetic tools — the real ones need a healthy chip."""
+
+import os
+import textwrap
+
+import pytest
+
+from tests.unit import common
+
+
+def _tool(tmp_path, body, monkeypatch):
+    monkeypatch.setattr(common, "REPO_ROOT", str(tmp_path))
+    os.makedirs(tmp_path / "tools", exist_ok=True)
+    (tmp_path / "tools" / "fake_tool.py").write_text(textwrap.dedent(body))
+    return "fake_tool.py"
+
+
+def test_healthy_pass(tmp_path, monkeypatch):
+    name = _tool(tmp_path, """
+        print("DEVICES_OK", flush=True)
+        print("PASS")
+    """, monkeypatch)
+    out = common.run_tpu_tool(name, timeout=30)
+    assert "PASS" in out
+
+
+def test_skip_marker(tmp_path, monkeypatch):
+    name = _tool(tmp_path, """
+        print("SKIP: no TPU attached")
+    """, monkeypatch)
+    with pytest.raises(pytest.skip.Exception):
+        common.run_tpu_tool(name, timeout=30)
+
+
+def test_claim_never_completes_skips(tmp_path, monkeypatch):
+    name = _tool(tmp_path, """
+        import time
+        time.sleep(60)          # silent: never prints DEVICES_OK
+    """, monkeypatch)
+    with pytest.raises(pytest.skip.Exception, match="claim never completed"):
+        common.run_tpu_tool(name, timeout=6)
+
+
+def test_post_claim_hang_fails(tmp_path, monkeypatch):
+    name = _tool(tmp_path, """
+        import time
+        print("DEVICES_OK", flush=True)
+        time.sleep(60)          # hang AFTER the claim
+    """, monkeypatch)
+    with pytest.raises(AssertionError, match="AFTER acquiring"):
+        common.run_tpu_tool(name, timeout=6)
+
+
+def test_child_failure_raises(tmp_path, monkeypatch):
+    name = _tool(tmp_path, """
+        print("DEVICES_OK", flush=True)
+        raise SystemExit(3)
+    """, monkeypatch)
+    with pytest.raises(AssertionError, match="child failed"):
+        common.run_tpu_tool(name, timeout=30)
