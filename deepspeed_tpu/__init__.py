@@ -117,3 +117,45 @@ def add_config_arguments(parser):
     group.add_argument("--deepscale_config", default=None, type=str,
                        help="Deprecated config path")
     return parser
+
+
+# ---- reference top-level re-exports (deepspeed/__init__.py surface) ---- #
+# PEP-562 lazy attributes: a reference user's `deepspeed.X` works without
+# paying every subsystem's import cost at package import.
+_LAZY_EXPORTS = {
+    "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+    "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine", "PipelineEngine"),
+    "PipelineModule": ("deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
+    "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
+    "DeepSpeedInferenceConfig": ("deepspeed_tpu.inference.config",
+                                 "DeepSpeedInferenceConfig"),
+    "DeepSpeedConfigError": ("deepspeed_tpu.runtime.config",
+                             "DeepSpeedConfigError"),
+    "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer",
+                                  "DeepSpeedTransformerLayer"),
+    "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer",
+                                   "DeepSpeedTransformerConfig"),
+    "OnDevice": ("deepspeed_tpu.utils.init_on_device", "OnDevice"),
+    "add_tuning_arguments": ("deepspeed_tpu.runtime.lr_schedules",
+                             "add_tuning_arguments"),
+    "checkpointing": (
+        "deepspeed_tpu.runtime.activation_checkpointing.checkpointing", None),
+    "module_inject": ("deepspeed_tpu.module_inject", None),
+    "ops": ("deepspeed_tpu.ops", None),
+}
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY_EXPORTS)))
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(mod_name)
+    value = mod if attr is None else getattr(mod, attr)
+    globals()[name] = value     # cache for subsequent lookups
+    return value

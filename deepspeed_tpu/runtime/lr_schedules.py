@@ -209,6 +209,50 @@ SCHEDULE_CLASSES = {
 }
 
 
+def _str2bool(v):
+    """argparse type for real on/off flags: the reference's type=bool wart
+    coerces ANY non-empty string (incl. "False") to True — kept out of
+    parity on purpose."""
+    if isinstance(v, bool):
+        return v
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def add_tuning_arguments(parser):
+    """Reference ``lr_schedules.py:56``: convergence-tuning CLI flags for
+    the four schedule families (consumed by user launch scripts; values
+    flow into the scheduler params of the JSON config).  Flag names come
+    from the canonical param-key constants above, so CLI and JSON cannot
+    drift apart."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    for key, typ, default in (
+            (LR_RANGE_TEST_MIN_LR, float, 0.001),
+            (LR_RANGE_TEST_STEP_RATE, float, 1.0),
+            (LR_RANGE_TEST_STEP_SIZE, int, 1000),
+            (LR_RANGE_TEST_STAIRCASE, _str2bool, False),
+            (CYCLE_FIRST_STEP_SIZE, int, 1000),
+            (CYCLE_FIRST_STAIR_COUNT, int, -1),
+            (CYCLE_SECOND_STEP_SIZE, int, -1),
+            (CYCLE_SECOND_STAIR_COUNT, int, -1),
+            (DECAY_STEP_SIZE, int, 1000),
+            (CYCLE_MIN_LR, float, 0.01),
+            (CYCLE_MAX_LR, float, 0.1),
+            (DECAY_LR_RATE, float, 0.0),
+            (CYCLE_MIN_MOM, float, 0.8),
+            (CYCLE_MAX_MOM, float, 0.9),
+            (DECAY_MOM_RATE, float, 0.0),
+            (WARMUP_MIN_LR, float, 0.0),
+            (WARMUP_MAX_LR, float, 0.001),
+            (WARMUP_NUM_STEPS, int, 1000),
+            (WARMUP_TYPE, str, "log"),
+    ):
+        group.add_argument(f"--{key}", type=typ, default=default)
+    return parser
+
+
 def get_lr_schedule(name: str, params: Dict[str, Any]):
     """Instantiate from the ``scheduler`` JSON block (reference
     ``engine.py:_scheduler_from_config``)."""
