@@ -10,16 +10,33 @@ same solver results from ``deepspeed_tpu.elasticity``.
 
 import argparse
 import json
+import os
 import shlex
 import subprocess
 import sys
 from concurrent.futures import ThreadPoolExecutor
 
 
+def _host_key_checking_mode(insecure_flag: bool) -> str:
+    """``accept-new`` trusts a host's key on first contact but still rejects
+    a CHANGED key (the MITM case the old blanket ``no`` waved through).
+    The blanket-disable escape hatch stays for ephemeral pools whose hosts
+    are re-imaged (and re-keyed) constantly: ``--insecure-host-keys`` or
+    ``DST_SSH_INSECURE_HOST_KEYS=1``."""
+    if insecure_flag or os.environ.get("DST_SSH_INSECURE_HOST_KEYS", "") in (
+            "1", "true", "yes"):
+        return "no"
+    return "accept-new"
+
+
 def dst_ssh_main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="dst-ssh", description="run a command on every hostfile host")
     parser.add_argument("-f", "--hostfile", default="/job/hostfile")
+    parser.add_argument("--insecure-host-keys", action="store_true",
+                        help="disable host-key verification entirely "
+                             "(StrictHostKeyChecking=no); default is "
+                             "accept-new. Also via DST_SSH_INSECURE_HOST_KEYS=1")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run on each host")
     args = parser.parse_args(argv)
@@ -31,10 +48,11 @@ def dst_ssh_main(argv=None) -> int:
         print(f"no hosts in {args.hostfile}", file=sys.stderr)
         return 1
     cmd = shlex.join(args.command)   # preserve arg quoting remotely
+    hkc = _host_key_checking_mode(args.insecure_host_keys)
 
     def run(host):
         p = subprocess.run(
-            ["ssh", "-o", "StrictHostKeyChecking=no", host, cmd],
+            ["ssh", "-o", f"StrictHostKeyChecking={hkc}", host, cmd],
             capture_output=True, text=True)
         return host, p.returncode, p.stdout, p.stderr
 

@@ -21,6 +21,7 @@ and optionally captures CUDA graphs.  TPU-native redesign:
   (``load_checkpoint``) for the same model.
 """
 
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -36,8 +37,13 @@ from deepspeed_tpu.utils.logging import log_dist
 class InferenceEngine:
 
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
-                 params=None, mesh=None, seed: int = 0, policy=None):
+                 params=None, mesh=None, seed: int = 0, policy=None,
+                 telemetry=None):
         self._config = config or DeepSpeedInferenceConfig()
+        # per-request latency/throughput records; None (the default) keeps
+        # serving fully async — no block_until_ready is ever issued
+        self.telemetry = telemetry
+        self._request_count = 0
         self.dtype = self._config.jnp_dtype
         # dtype="int8" means weight-only int8 (reference quantizes injected
         # weights when config.dtype == torch.int8, GroupQuantizer
@@ -136,6 +142,24 @@ class InferenceEngine:
         return self
 
     # ------------------------------------------------------------------ #
+    def _record_request(self, op, t0, out, new_tokens=0):
+        """Per-request telemetry record.  Blocks on the request's own output
+        (not the whole device) to get a true end-to-end latency; compiled
+        here means telemetry-off serving never blocks at all."""
+        if self.telemetry is None:
+            return out
+        jax.block_until_ready(out)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        rec = {"op": op, "latency_ms": dt * 1000.0}
+        if hasattr(out, "shape") and getattr(out, "ndim", 0) >= 1:
+            rec["batch"] = int(out.shape[0])
+        if new_tokens:
+            rec["new_tokens"] = int(new_tokens)
+            rec["tokens_per_sec"] = new_tokens / dt
+        self._request_count += 1
+        self.telemetry.emit("inference_request", rec, step=self._request_count)
+        return out
+
     def forward(self, input_ids, *args, attention_mask=None, **kwargs):
         """Full-sequence logits (one jitted program per input shape).
         ``attention_mask`` [B, S] is honored when the model's
@@ -165,7 +189,9 @@ class InferenceEngine:
                 else jax.jit(lambda p, i, m: fwd(p, i, None))
         mask = (jnp.asarray(attention_mask) if attention_mask is not None
                 else jnp.ones_like(input_ids))
-        return self._forward_fn(self.params, input_ids, mask)
+        t0 = time.perf_counter()
+        out = self._forward_fn(self.params, input_ids, mask)
+        return self._record_request("forward", t0, out)
 
     __call__ = forward
 
@@ -204,10 +230,13 @@ class InferenceEngine:
                                           prompt_len=plen)
                 self._generate_fns[key] = jax.jit(gen)
             r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
+            t0 = time.perf_counter()
             out = self._generate_fns[key](self.params, ids,
                                           jnp.asarray(S, jnp.int32), r)
             # drop the pad tail: [prompt | pad | new] -> [prompt | new]
-            return jnp.concatenate([out[:, :S], out[:, S_pad:]], axis=1)
+            out = jnp.concatenate([out[:, :S], out[:, S_pad:]], axis=1)
+            return self._record_request("generate", t0, out,
+                                        new_tokens=B * max_new_tokens)
         key = (input_ids.shape, max_new_tokens, float(temperature))
         if key not in self._generate_fns:
             def gen(params, ids, r):
@@ -216,7 +245,10 @@ class InferenceEngine:
 
             self._generate_fns[key] = jax.jit(gen)
         r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
-        return self._generate_fns[key](self.params, input_ids, r)
+        t0 = time.perf_counter()
+        out = self._generate_fns[key](self.params, input_ids, r)
+        return self._record_request("generate", t0, out,
+                                    new_tokens=B * max_new_tokens)
 
 
 def init_inference(model=None, config=None, **kwargs):
@@ -227,6 +259,14 @@ def init_inference(model=None, config=None, **kwargs):
     mesh = cfg_dict.pop("mesh", None)
     params = cfg_dict.pop("params", None)
     policy = cfg_dict.pop("injection_policy", cfg_dict.pop("policy", None))
+    # "telemetry" is either a TelemetryHub instance (shared with a training
+    # engine) or a telemetry config dict to build a standalone hub from
+    telemetry = cfg_dict.pop("telemetry", None)
+    if isinstance(telemetry, dict):
+        from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
+        from deepspeed_tpu.telemetry import TelemetryHub
+        tcfg = DeepSpeedTelemetryConfig(**telemetry)
+        telemetry = TelemetryHub.from_config(tcfg) if tcfg.enabled else None
     ds_config = DeepSpeedInferenceConfig(**cfg_dict)
     return InferenceEngine(model, config=ds_config, params=params, mesh=mesh,
-                           policy=policy)
+                           policy=policy, telemetry=telemetry)

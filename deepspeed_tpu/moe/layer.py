@@ -14,7 +14,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 from deepspeed_tpu.moe.experts import Experts, FFNExpert
-from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_dispatch_combine
+from deepspeed_tpu.moe.sharded_moe import (TopKGate, emit_expert_gauges,
+                                           moe_dispatch_combine)
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
 
@@ -31,7 +32,13 @@ class MoE:
                  ep_size: int = 1, k: int = 1, capacity_factor: float = 1.0,
                  eval_capacity_factor: float = 1.0, min_capacity: int = 4,
                  noisy_gate_policy: Optional[str] = None, drop_tokens: bool = True,
-                 use_rts: bool = True, expert_hidden: Optional[int] = None):
+                 use_rts: bool = True, expert_hidden: Optional[int] = None,
+                 telemetry=None):
+        # optional TelemetryHub for expert-load/drop gauges; only consulted
+        # on eager calls (under jit the inputs are tracers and emission
+        # would capture them, so it is skipped there)
+        self.telemetry = telemetry
+        self._gauge_step = 0
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         self.ep_size = ep_size
@@ -63,4 +70,8 @@ class MoE:
                                                          rng=rng, train=train)
         y = moe_dispatch_combine(xt, combine, dispatch, self.experts.expert,
                                  params["experts"])
+        if self.telemetry is not None and not isinstance(exp_counts, jax.core.Tracer):
+            self._gauge_step += 1
+            emit_expert_gauges(self.telemetry, exp_counts, dispatch,
+                               k=self.gate.k, step=self._gauge_step)
         return y.reshape(*lead, M).astype(x.dtype), l_aux, exp_counts

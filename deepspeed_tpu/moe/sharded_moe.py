@@ -136,6 +136,48 @@ def top2gating(logits: Array, capacity_factor: float = 1.0, min_capacity: int = 
     return l_aux, combine, combine > 0, exp_counts
 
 
+def expert_load_metrics(exp_counts: Array, dispatch_mask: Array,
+                        k: int = 1) -> dict:
+    """Expert-load / drop-fraction gauges from one gating decision.
+
+    Pure jnp on traced arrays — safe to call under jit, and the returned
+    device scalars can sit in a telemetry ring buffer until the next drain
+    (no host sync here, matching the module's no-sync contract above).
+
+    ``exp_counts`` [E] counts first-choice assignments; ``dispatch_mask``
+    [T, E, C] marks tokens that actually won a capacity slot, so
+    ``drop_fraction = 1 - kept / (T * k)`` — the fraction of routed tokens
+    (k routes per token) that fell off the end of an expert's capacity.
+    """
+    total = jnp.maximum(jnp.sum(exp_counts), 1.0)
+    load = exp_counts / total                       # [E] first-choice shares
+    T = dispatch_mask.shape[0]
+    kept = jnp.sum(dispatch_mask.astype(jnp.float32))
+    drop_fraction = 1.0 - kept / float(max(T * k, 1))
+    return {
+        "expert_load": load,
+        "load_max": jnp.max(load),
+        "load_min": jnp.min(load),
+        # perfectly balanced load → 1.0; one hot expert → 1/E
+        "load_entropy_frac": -jnp.sum(jnp.where(load > 0, load * jnp.log(load), 0.0))
+                             / math.log(max(exp_counts.shape[0], 2)),
+        "drop_fraction": jnp.clip(drop_fraction, 0.0, 1.0),
+        "tokens": float(T),
+    }
+
+
+def emit_expert_gauges(hub, exp_counts: Array, dispatch_mask: Array,
+                       k: int = 1, step=None, layer: str = ""):
+    """Buffer a ``moe_gauge`` record on a TelemetryHub (no-op when hub is
+    None).  Values stay on device until the hub's windowed drain."""
+    if hub is None:
+        return
+    payload = expert_load_metrics(exp_counts, dispatch_mask, k=k)
+    if layer:
+        payload["layer"] = layer
+    hub.emit("moe_gauge", payload, step=step)
+
+
 class TopKGate:
     """Gate module (reference ``TopKGate:343``): linear wg + top-k gating."""
 

@@ -70,6 +70,24 @@ class DeepSpeedCommsConfig(DeepSpeedConfigModel):
     prof_ops: list = Field(default_factory=list)
 
 
+class DeepSpeedTelemetryConfig(DeepSpeedConfigModel):
+    """``telemetry`` block — the TelemetryHub + profiler-window knobs.
+
+    Off by default; when enabled the engine emits one structured record per
+    optimizer step and drains them (one device sync) every ``flush_every``
+    steps.  See README.md § Telemetry for the JSONL schema.
+    """
+    enabled: bool = False
+    jsonl_path: str = ""                 # rank-0 JSONL sink ("" disables)
+    ring_buffer_size: int = 1024         # in-memory sink (0 disables)
+    flush_every: int = 0                 # 0 → follow steps_per_print (or 50)
+    # windowed XLA profiler capture over [start, end) global steps
+    profiler_start_step: int = 0
+    profiler_end_step: int = 0           # 0 → profiler disabled
+    profiler_dir: str = "/tmp/deepspeed_tpu_trace"
+    profiler_max_window_steps: int = 64  # unbounded-trace guard
+
+
 class DeepSpeedActivationCheckpointingConfig(DeepSpeedConfigModel):
     """``activation_checkpointing`` block (reference
     ``runtime/activation_checkpointing/config.py``); on TPU these select a
@@ -273,6 +291,7 @@ class DeepSpeedConfig:
         self.csv_monitor_config = DeepSpeedMonitorSubConfig(**pd.get(C.MONITOR_CONFIG_CSV, {}))
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(**pd.get(C.FLOPS_PROFILER, {}))
         self.comms_config = DeepSpeedCommsConfig(**pd.get(C.COMMS_LOGGER, {}))
+        self.telemetry_config = DeepSpeedTelemetryConfig(**pd.get(C.TELEMETRY, {}))
         self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(
             **pd.get(C.ACTIVATION_CHECKPOINTING, {}))
         self.checkpoint_config = DeepSpeedCheckpointConfig(**pd.get(C.CHECKPOINT, {}))

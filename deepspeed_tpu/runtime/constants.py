@@ -135,6 +135,7 @@ SEQUENCE_PARALLEL = "sequence_parallel"
 ZERO_OPTIMIZATION = "zero_optimization"
 ACTIVATION_CHECKPOINTING = "activation_checkpointing"
 COMMS_LOGGER = "comms_logger"
+TELEMETRY = "telemetry"
 MONITOR_CONFIG_TENSORBOARD = "tensorboard"
 MONITOR_CONFIG_WANDB = "wandb"
 MONITOR_CONFIG_CSV = "csv_monitor"

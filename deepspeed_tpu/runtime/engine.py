@@ -196,6 +196,21 @@ class DeepSpeedEngine:
             from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
             self.flops_profiler = FlopsProfiler(self)
 
+        # ---- telemetry (structured step events + windowed XLA trace) --- #
+        # None when disabled: the train step then takes no telemetry branch
+        # at all, preserving the zero-extra-sync guarantee.
+        self.telemetry = None
+        self.profiler_window = None
+        tcfg = self._config.telemetry_config
+        if tcfg.enabled:
+            from deepspeed_tpu.telemetry import ProfilerWindow, TelemetryHub
+            self.telemetry = TelemetryHub.from_config(
+                tcfg, monitor=self.monitor, comms_logger=self.comms_logger,
+                flops_profiler=self.flops_profiler,
+                batch_size=self.train_batch_size(),
+                steps_per_print=self._config.steps_per_print)
+            self.profiler_window = ProfilerWindow.from_config(tcfg)
+
         # progressive layer drop
         self.progressive_layer_drop = None
         if self._config.pld_config.enabled:
@@ -1003,6 +1018,8 @@ class DeepSpeedEngine:
         if self.flops_profiler:
             self.flops_profiler.start_profile(
                 batch, num_micro_steps=self.gradient_accumulation_steps())
+        if self._in_training_mode and self.profiler_window is not None:
+            self.profiler_window.step_begin(self.global_steps)
         self.timers(FORWARD_MICRO_TIMER).start(sync=False)
 
         if self._in_training_mode:
@@ -1168,6 +1185,19 @@ class DeepSpeedEngine:
                 if self.global_steps == fc.profile_step:
                     self.flops_profiler.print_model_profile(
                         profile_step=fc.profile_step, output_file=fc.output_file)
+            if self.telemetry is not None:
+                # values stay device arrays here; the hub drains them (one
+                # sync) at the flush boundary, never per step
+                loss = self._cached_loss
+                self.telemetry.record_step(
+                    self.global_steps,
+                    loss=jnp.mean(loss) if loss is not None else stats.get("loss"),
+                    lr=self.get_lr()[0],
+                    grad_norm=stats.get("grad_norm"),
+                    loss_scale=stats.get("loss_scale"),
+                    global_samples=self.global_samples)
+            if self.profiler_window is not None:
+                self.profiler_window.step_end(self.global_steps)
             self._report_progress()
 
     def train_batch(self, data_iter=None, batch=None):
@@ -1215,11 +1245,14 @@ class DeepSpeedEngine:
             # one micro-batch's cost x gas = the whole fused step
             self.flops_profiler.start_profile(jax.tree.map(lambda x: x[0], batch),
                                               num_micro_steps=self.gradient_accumulation_steps())
+        if self.profiler_window is not None:
+            self.profiler_window.step_begin(self.global_steps)
         self.tput_timer.start()
         carry = (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped)
         carry, loss, stats = self._fused_step(carry, batch, self._next_rng())
         (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped) = carry
         self._step_stats = stats
+        self._cached_loss = loss
         self.micro_steps += self.gradient_accumulation_steps()
         self._advance_step_counters(stats)
         self.tput_timer.stop(global_step=True)
@@ -1293,6 +1326,27 @@ class DeepSpeedEngine:
 
     def monitor_enabled(self):
         return self._config.monitor_enabled
+
+    def telemetry_flush(self):
+        """Drain buffered telemetry records to all sinks now (one device
+        sync).  No-op when telemetry is disabled."""
+        if self.telemetry is not None:
+            self.telemetry.flush()
+
+    def telemetry_close(self):
+        """End-of-run hook: stop any in-flight profiler trace, emit the
+        comms summary, and flush + close every sink.  Idempotent."""
+        if self.profiler_window is not None:
+            self.profiler_window.close()
+        if self.telemetry is not None:
+            if self.comms_logger is not None:
+                try:
+                    summary = self.comms_logger.summary()
+                    self.telemetry.emit("comm_summary", summary,
+                                        step=self.global_steps)
+                except Exception as e:
+                    logger.warning(f"comms summary emission failed: {e}")
+            self.telemetry.close()
 
     def _report_progress(self):
         spp = self._config.steps_per_print

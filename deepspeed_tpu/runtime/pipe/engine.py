@@ -676,6 +676,17 @@ class PipelineEngine(DeepSpeedEngine):
     def is_pipe_parallel(self):
         return True
 
+    def bubble_fraction(self, micro_batches=None):
+        """Analytic schedule-idle fraction: both schedules run a fixed tick
+        count ``T`` with ``M`` useful micro-batch slots per stage, so the
+        bubble is ``1 - M/T`` — gpipe ``T = M + P - 1``, 1f1b (forward and
+        backward interleaved over separate tick halves) ``T = M + 2P - 1``.
+        Pure host arithmetic: no device work, safe to call per step."""
+        M = micro_batches if micro_batches is not None else self.gradient_accumulation_steps()
+        P = self._adapted.P
+        ticks = M + (2 * P - 1 if self.schedule == "1f1b" else P - 1)
+        return 1.0 - float(M) / float(ticks)
+
     def _grad_accum_divisor(self) -> float:
         # the pipelined program already averages the loss over micro-batches
         return 1.0
@@ -725,6 +736,13 @@ class PipelineEngine(DeepSpeedEngine):
         finally:
             self._inside_train_batch = False
         self.tput_timer.stop(global_step=True)
+        if self.telemetry is not None:
+            self.telemetry.emit("pipe", {
+                "schedule": self.schedule,
+                "stages": self._adapted.P,
+                "micro_batches": gas,
+                "bubble_fraction": self.bubble_fraction(gas),
+            }, step=self.global_steps)
         return loss
 
     def eval_batch(self, batch):

@@ -1,0 +1,24 @@
+"""deepspeed_tpu.telemetry — structured step events, JSONL sink, windowed
+XLA profiler capture.  See README.md § Telemetry for config keys and the
+JSONL schema."""
+
+from deepspeed_tpu.telemetry import events
+from deepspeed_tpu.telemetry.events import (SCHEMA_VERSION,
+                                            STEP_REQUIRED_FIELDS, make_record)
+from deepspeed_tpu.telemetry.hub import (JsonlSink, MonitorSink,
+                                         RingBufferSink, TelemetryHub,
+                                         TelemetrySink)
+from deepspeed_tpu.telemetry.profiler import ProfilerWindow
+
+__all__ = [
+    "events",
+    "SCHEMA_VERSION",
+    "STEP_REQUIRED_FIELDS",
+    "make_record",
+    "TelemetryHub",
+    "TelemetrySink",
+    "JsonlSink",
+    "RingBufferSink",
+    "MonitorSink",
+    "ProfilerWindow",
+]

@@ -1,0 +1,49 @@
+"""Telemetry record schema.
+
+Every record the :class:`~deepspeed_tpu.telemetry.hub.TelemetryHub` emits is
+a flat JSON-serializable dict with two reserved keys:
+
+* ``kind`` — the record type (one of :data:`KINDS`);
+* ``schema`` — the schema version (:data:`SCHEMA_VERSION`), stamped by the
+  hub so a JSONL file is self-describing and ``tools/telemetry_report.py``
+  can refuse files it does not understand.
+
+``step`` records additionally guarantee :data:`STEP_REQUIRED_FIELDS` — the
+contract the JSONL acceptance test and the report folder both rely on.
+Values may be device arrays at emission time; the hub converts them to host
+floats at drain boundaries (see the hub's windowed-drain discipline).
+"""
+
+from typing import Any, Dict
+
+SCHEMA_VERSION = 1
+
+# record kinds ------------------------------------------------------------- #
+STEP = "step"                      # one optimizer step of a training engine
+PIPE = "pipe"                      # pipeline schedule stats (bubble fraction)
+INFERENCE = "inference_request"    # one generate()/forward() serving request
+MOE = "moe_gauge"                  # expert-load / drop-fraction gauges
+COMM_SUMMARY = "comm_summary"      # CommsLogger fold (op counts/bytes/bw)
+SCHEMA = "schema"                  # JSONL header record (written by the sink)
+
+KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, SCHEMA)
+
+# Every `step` record carries at least these keys once drained.
+STEP_REQUIRED_FIELDS = (
+    "step",
+    "loss",
+    "lr",
+    "step_time_ms",
+    "samples_per_sec",
+    "comm_bytes",
+    "device_peak_bytes",
+)
+
+
+def make_record(kind: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp ``kind``/``schema`` onto a payload (payload keys win nothing:
+    the reserved keys are overwritten)."""
+    rec = dict(payload)
+    rec["kind"] = kind
+    rec["schema"] = SCHEMA_VERSION
+    return rec

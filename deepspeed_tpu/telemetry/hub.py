@@ -1,0 +1,333 @@
+"""TelemetryHub — the structured event bus every engine emits into.
+
+Design constraints (the point of this module):
+
+* **Telemetry-off costs nothing.**  Engines hold ``telemetry = None`` when
+  the config block is absent; no code path below ever runs.
+* **Telemetry-on never syncs the device per step.**  ``record_step`` only
+  appends a dict whose values may still be in-flight ``jax.Array``s — the
+  same windowed-drain discipline as ``ThroughputTimer``.  One device drain
+  happens per flush window (default: the engine's report boundary), after
+  which every buffered value is a cheap ready-array read.
+* **Sinks are pluggable.**  A rank-0 append-only JSONL file (schema-
+  versioned, consumed by ``tools/telemetry_report.py``), the existing
+  ``MonitorMaster`` writers (TensorBoard/W&B/CSV), and an in-memory ring
+  buffer queryable from tests.
+"""
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.telemetry import events
+from deepspeed_tpu.utils.logging import logger
+
+
+def _to_host(value: Any) -> Any:
+    """JSON-ready host value from a (ready) device array / numpy / scalar."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        return {k: _to_host(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_host(v) for v in value]
+    try:
+        arr = np.asarray(value)
+    except Exception:
+        return str(value)
+    if arr.ndim == 0 and arr.dtype.kind == "O":
+        return str(value)
+    if arr.ndim == 0:
+        if arr.dtype.kind == "b":
+            return bool(arr)
+        if arr.dtype.kind in "iu":
+            return int(arr)
+        return float(arr)
+    return arr.tolist()
+
+
+# --------------------------------------------------------------------------- #
+# Sinks
+# --------------------------------------------------------------------------- #
+class TelemetrySink:
+    """Interface: receives fully-drained (host-value) records."""
+
+    def write(self, records: List[Dict[str, Any]]):
+        raise NotImplementedError
+
+    def close(self):
+        ...
+
+
+class JsonlSink(TelemetrySink):
+    """Append-only JSONL file, rank-0 only.  The first line of a fresh file
+    is a ``schema`` header record so the file is self-describing."""
+
+    def __init__(self, path: str, rank: int = 0):
+        self.path = path
+        self.rank = rank
+        self._fh = None
+
+    def _ensure_open(self):
+        if self._fh is not None:
+            return
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        fresh = not (os.path.exists(self.path) and os.path.getsize(self.path) > 0)
+        self._fh = open(self.path, "a")
+        if fresh:
+            header = events.make_record(events.SCHEMA,
+                                        {"version": events.SCHEMA_VERSION,
+                                         "created_unix": time.time()})
+            self._fh.write(json.dumps(header) + "\n")
+
+    def write(self, records):
+        if self.rank != 0 or not records:
+            return
+        self._ensure_open()
+        for rec in records:
+            self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class RingBufferSink(TelemetrySink):
+    """Bounded in-memory record buffer — the test/debug query surface."""
+
+    def __init__(self, capacity: int = 1024):
+        self.records = deque(maxlen=max(1, capacity))
+
+    def write(self, records):
+        self.records.extend(records)
+
+    def of_kind(self, kind: str) -> List[Dict[str, Any]]:
+        return [r for r in self.records if r.get("kind") == kind]
+
+    def last(self, kind: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        pool = self.records if kind is None else self.of_kind(kind)
+        return pool[-1] if pool else None
+
+
+class MonitorSink(TelemetrySink):
+    """Fan step records out to the existing ``MonitorMaster`` writers as
+    ``(name, value, step)`` scalar events (reference monitor convention)."""
+
+    # step-record fields forwarded as monitor scalars
+    FIELDS = ("loss", "lr", "grad_norm", "step_time_ms", "samples_per_sec",
+              "tflops_per_chip", "comm_bytes", "device_peak_bytes")
+
+    def __init__(self, monitor, prefix: str = "Train/Telemetry"):
+        self.monitor = monitor
+        self.prefix = prefix
+
+    def write(self, records):
+        evs = []
+        for rec in records:
+            if rec.get("kind") != events.STEP:
+                continue
+            step = rec.get("step", 0)
+            for f in self.FIELDS:
+                v = rec.get(f)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    evs.append((f"{self.prefix}/{f}", v, step))
+        if evs:
+            self.monitor.write_events(evs)
+
+
+# --------------------------------------------------------------------------- #
+# Hub
+# --------------------------------------------------------------------------- #
+class TelemetryHub:
+    """Buffers typed records and drains them to sinks at window boundaries.
+
+    ``flush_every`` step records per window (0/None disables auto-flush —
+    callers flush at their own report boundary).  Per-step cost is one dict
+    append + one ``time.time()``; the single device drain per window happens
+    inside :meth:`flush`.
+    """
+
+    def __init__(self, sinks: Optional[List[TelemetrySink]] = None,
+                 flush_every: int = 50,
+                 batch_size: int = 1,
+                 device_count: int = 1,
+                 lr_fn: Optional[Callable[[int], float]] = None,
+                 comms_logger=None,
+                 flops_per_step: Optional[Callable[[], float]] = None,
+                 sync_fn: Optional[Callable[[], None]] = None,
+                 memory_stats_fn: Optional[Callable[[], Dict[str, int]]] = None):
+        self.sinks = list(sinks or [])
+        self.flush_every = int(flush_every or 0)
+        self.batch_size = max(1, int(batch_size))
+        self.device_count = max(1, int(device_count))
+        self.lr_fn = lr_fn
+        self.comms_logger = comms_logger
+        self.flops_per_step = flops_per_step
+        self._sync_fn = sync_fn
+        self._memory_stats_fn = memory_stats_fn
+        self._pending: List[Dict[str, Any]] = []
+        self._pending_steps = 0
+        self._window_t = time.time()     # wall clock of the last drained step
+        self._window_comm = 0            # cumulative comm bytes at last record
+        self.closed = False
+
+    # -- construction ---------------------------------------------------- #
+    @classmethod
+    def from_config(cls, tcfg, monitor=None, comms_logger=None,
+                    flops_profiler=None, batch_size: int = 1,
+                    steps_per_print: Optional[int] = None):
+        """Build the hub + sinks from a ``telemetry`` config block
+        (``runtime/config.py:DeepSpeedTelemetryConfig``)."""
+        import jax
+        rank = jax.process_index()
+        sinks: List[TelemetrySink] = []
+        if tcfg.jsonl_path:
+            sinks.append(JsonlSink(tcfg.jsonl_path, rank=rank))
+        if tcfg.ring_buffer_size:
+            sinks.append(RingBufferSink(tcfg.ring_buffer_size))
+        if monitor is not None:
+            sinks.append(MonitorSink(monitor))
+        flush_every = tcfg.flush_every or steps_per_print or 50
+        flops_fn = None
+        if flops_profiler is not None:
+            flops_fn = lambda: flops_profiler.flops_per_step  # noqa: E731
+        return cls(sinks=sinks, flush_every=flush_every, batch_size=batch_size,
+                   device_count=jax.device_count(), comms_logger=comms_logger,
+                   flops_per_step=flops_fn)
+
+    # -- sink queries (tests) -------------------------------------------- #
+    def add_sink(self, sink: TelemetrySink):
+        self.sinks.append(sink)
+
+    @property
+    def ring(self) -> Optional[RingBufferSink]:
+        for s in self.sinks:
+            if isinstance(s, RingBufferSink):
+                return s
+        return None
+
+    # -- emission (zero-sync hot path) ------------------------------------ #
+    def _comm_totals(self):
+        if self.comms_logger is None:
+            return 0, 0
+        try:
+            return (self.comms_logger.total_bytes(),
+                    self.comms_logger.total_ops())
+        except Exception:
+            return 0, 0
+
+    def record_step(self, step: int, **fields):
+        """Buffer one per-step record.  Values may be device arrays; nothing
+        here blocks on the device."""
+        if self.closed:
+            return
+        rec: Dict[str, Any] = {"step": int(step), "_t": time.time()}
+        cbytes, cops = self._comm_totals()
+        rec["_comm_bytes_cum"] = cbytes
+        rec["_comm_ops_cum"] = cops
+        rec.update(fields)
+        self._pending.append(events.make_record(events.STEP, rec))
+        self._pending_steps += 1
+        if self.flush_every and self._pending_steps >= self.flush_every:
+            self.flush()
+
+    def emit(self, kind: str, payload: Dict[str, Any], step: Optional[int] = None):
+        """Buffer a non-step record (pipe/inference/moe/comm summary)."""
+        if self.closed:
+            return
+        rec = dict(payload)
+        if step is not None:
+            rec["step"] = int(step)
+        self._pending.append(events.make_record(kind, rec))
+
+    # -- drain ------------------------------------------------------------ #
+    def _drain_device(self):
+        if self._sync_fn is not None:
+            self._sync_fn()
+            return
+        from deepspeed_tpu.utils.timer import _sync_device
+        _sync_device()
+
+    def _device_peak_bytes(self) -> int:
+        if self._memory_stats_fn is not None:
+            stats = self._memory_stats_fn() or {}
+        else:
+            try:
+                import jax
+                stats = jax.local_devices()[0].memory_stats() or {}
+            except Exception:
+                stats = {}
+        return int(stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)))
+
+    def flush(self):
+        """Drain the device once, resolve buffered values to host floats,
+        derive windowed rates, and fan records out to every sink."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        self._pending_steps = 0
+        self._drain_device()
+        peak = self._device_peak_bytes()
+        flops = None
+        if self.flops_per_step is not None:
+            try:
+                flops = self.flops_per_step()
+            except Exception:
+                flops = None
+
+        out: List[Dict[str, Any]] = []
+        prev_t = self._window_t
+        prev_comm = self._window_comm
+        for rec in pending:
+            if rec.get("kind") != events.STEP:
+                out.append({k: _to_host(v) for k, v in rec.items()})
+                continue
+            t = rec.pop("_t")
+            comm_cum = rec.pop("_comm_bytes_cum", 0)
+            ops_cum = rec.pop("_comm_ops_cum", 0)
+            dt = max(t - prev_t, 1e-9)
+            resolved = {k: _to_host(v) for k, v in rec.items()}
+            resolved["step_time_ms"] = dt * 1000.0
+            resolved["samples_per_sec"] = self.batch_size / dt
+            resolved["comm_bytes"] = max(0, comm_cum - prev_comm)
+            resolved["comm_ops"] = ops_cum
+            resolved["device_peak_bytes"] = peak
+            resolved.setdefault("loss", 0.0)
+            if self.lr_fn is not None and "lr" not in resolved:
+                try:
+                    resolved["lr"] = float(self.lr_fn(resolved["step"]))
+                except Exception:
+                    resolved["lr"] = 0.0
+            resolved.setdefault("lr", 0.0)
+            if flops:
+                resolved["tflops_per_chip"] = (
+                    flops / dt / 1e12 / self.device_count)
+            out.append(resolved)
+            prev_t = t
+            prev_comm = comm_cum
+        self._window_t = prev_t
+        self._window_comm = prev_comm
+
+        for sink in self.sinks:
+            try:
+                sink.write(out)
+            except Exception as e:
+                logger.warning(f"telemetry sink {type(sink).__name__} failed: {e}")
+
+    def close(self):
+        if self.closed:
+            return
+        self.flush()
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:
+                pass
+        self.closed = True

@@ -1,0 +1,114 @@
+"""Windowed XLA profiler capture.
+
+Config-driven ``jax.profiler.start_trace`` / ``stop_trace`` over a single
+``[start_step, end_step)`` window.  The state machine has exactly three
+states — idle → active → done — and two invariants the tests pin down:
+
+* a trace **never starts twice** (once done, the window stays done even if
+  the step counter wraps or re-enters the window);
+* a trace **always stops** — via ``step_end`` once the window closes, or
+  via ``close()`` on engine teardown, whichever comes first.  The window
+  length is clamped to ``max_window_steps`` so a mis-configured
+  ``end_step`` can never leave tracing running unbounded.
+
+``start_fn``/``stop_fn`` are injectable for tests; the defaults wrap
+``jax.profiler`` and swallow backend errors (profiling is best-effort
+observability, never a reason to kill a training run).
+"""
+
+from typing import Callable, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+IDLE = "idle"
+ACTIVE = "active"
+DONE = "done"
+
+#: hard ceiling on a capture window — XLA traces are large, and an
+#: unbounded trace can fill a host disk in minutes.
+MAX_WINDOW_STEPS = 64
+
+
+def _default_start(log_dir: str):
+    import jax
+    jax.profiler.start_trace(log_dir)
+
+
+def _default_stop():
+    import jax
+    jax.profiler.stop_trace()
+
+
+class ProfilerWindow:
+    """One-shot profiler capture over ``[start_step, end_step)``."""
+
+    def __init__(self, start_step: int, end_step: int, log_dir: str,
+                 max_window_steps: int = MAX_WINDOW_STEPS,
+                 start_fn: Optional[Callable[[str], None]] = None,
+                 stop_fn: Optional[Callable[[], None]] = None):
+        self.start_step = int(start_step)
+        clamp = self.start_step + max(1, int(max_window_steps))
+        self.end_step = min(int(end_step), clamp)
+        if int(end_step) > clamp:
+            logger.warning(
+                f"profiler window [{start_step}, {end_step}) clamped to "
+                f"[{self.start_step}, {self.end_step}) "
+                f"(max_window_steps={max_window_steps})")
+        self.log_dir = log_dir
+        self.state = IDLE
+        self._start_fn = start_fn or _default_start
+        self._stop_fn = stop_fn or _default_stop
+
+    @property
+    def active(self) -> bool:
+        return self.state == ACTIVE
+
+    def step_begin(self, step: int):
+        """Call with the about-to-run step index (pre-increment counter)."""
+        if self.state != IDLE:
+            return
+        if self.start_step <= step < self.end_step:
+            try:
+                self._start_fn(self.log_dir)
+            except Exception as e:
+                logger.warning(f"profiler start_trace failed: {e}")
+                self.state = DONE
+                return
+            self.state = ACTIVE
+            logger.info(f"profiler trace started at step {step} "
+                        f"(window [{self.start_step}, {self.end_step}) "
+                        f"-> {self.log_dir})")
+
+    def step_end(self, completed_steps: int):
+        """Call with the number of completed steps (post-increment counter)."""
+        if self.state == ACTIVE and completed_steps >= self.end_step:
+            self._stop()
+
+    def _stop(self):
+        try:
+            self._stop_fn()
+        except Exception as e:
+            logger.warning(f"profiler stop_trace failed: {e}")
+        finally:
+            self.state = DONE
+            logger.info(f"profiler trace stopped -> {self.log_dir}")
+
+    def close(self):
+        """Teardown hook: stop an in-flight trace no matter where the step
+        counter is.  Idempotent."""
+        if self.state == ACTIVE:
+            self._stop()
+
+    @classmethod
+    def from_config(cls, tcfg) -> Optional["ProfilerWindow"]:
+        """Build from a ``DeepSpeedTelemetryConfig``; None when disabled."""
+        if not tcfg.profiler_start_step and not tcfg.profiler_end_step:
+            return None
+        start = tcfg.profiler_start_step or 0
+        end = tcfg.profiler_end_step or (start + 1)
+        if end <= start:
+            logger.warning(
+                f"profiler window [{start}, {end}) is empty; disabled")
+            return None
+        return cls(start, end, tcfg.profiler_dir,
+                   max_window_steps=tcfg.profiler_max_window_steps)

@@ -1,0 +1,157 @@
+"""Fold a telemetry JSONL run into a BENCH_*.json-shaped summary.
+
+The fold logic lives here (importable by tests); ``tools/telemetry_report.py``
+is a thin CLI over :func:`fold_run`.  Output mirrors the repo's
+``BENCH_DETAIL_*.json`` convention: a dict of named entries, each with
+``metric``/``value``/``unit`` plus supporting scalars.
+
+Robust-statistics note: steady-state rates use :func:`trim_mean` from
+``utils/timer.py`` (drop the top/bottom tail) so compile steps and stragglers
+don't skew the headline number.
+"""
+
+import json
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.telemetry import events
+from deepspeed_tpu.utils.timer import trim_mean
+
+
+class SchemaError(ValueError):
+    """JSONL file is missing/has an incompatible schema header."""
+
+
+def load_records(path: str, strict_schema: bool = True) -> List[Dict[str, Any]]:
+    """Parse a telemetry JSONL file, validating the schema version."""
+    records = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SchemaError(f"{path}:{lineno}: not JSON: {e}") from e
+            records.append(rec)
+    if strict_schema:
+        versions = {r.get("schema") for r in records if "schema" in r}
+        bad = versions - {events.SCHEMA_VERSION}
+        if bad:
+            raise SchemaError(
+                f"{path}: schema version(s) {sorted(bad)} not supported "
+                f"(this reader understands {events.SCHEMA_VERSION})")
+    return records
+
+
+def _steps(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [r for r in records if r.get("kind") == events.STEP]
+
+
+def _vals(recs: List[Dict[str, Any]], field: str) -> List[float]:
+    return [float(r[field]) for r in recs
+            if isinstance(r.get(field), (int, float))
+            and not isinstance(r.get(field), bool)]
+
+
+def _robust(vals: List[float], trim: float = 0.1) -> Optional[float]:
+    if not vals:
+        return None
+    return trim_mean(vals, trim)
+
+
+def fold_run(records: List[Dict[str, Any]], label: str = "run",
+             skip_steps: int = 1, trim: float = 0.1) -> Dict[str, Any]:
+    """Collapse a record stream into a BENCH-shaped summary dict.
+
+    ``skip_steps`` drops the first N step records (compile/warm-up) before
+    computing steady-state rates; ``trim`` is the two-sided trim fraction.
+    """
+    steps = _steps(records)
+    steady = steps[skip_steps:] if len(steps) > skip_steps else steps
+    out: Dict[str, Any] = {}
+
+    if steps:
+        sps = _robust(_vals(steady, "samples_per_sec"), trim)
+        step_ms = _robust(_vals(steady, "step_time_ms"), trim)
+        losses = _vals(steps, "loss")
+        entry: Dict[str, Any] = {
+            "metric": f"{label} steady-state throughput "
+                      f"({len(steps)} steps, {skip_steps} warm-up dropped)",
+            "value": round(sps, 4) if sps is not None else None,
+            "unit": "samples/sec",
+            "steps": len(steps),
+            "step_time_ms": round(step_ms, 4) if step_ms is not None else None,
+        }
+        if losses:
+            entry["loss"] = round(losses[-1], 6)
+            entry["loss_first"] = round(losses[0], 6)
+        lrs = _vals(steps, "lr")
+        if lrs:
+            entry["lr_last"] = lrs[-1]
+        tflops = _robust(_vals(steady, "tflops_per_chip"), trim)
+        if tflops is not None:
+            entry["tflops_per_chip"] = round(tflops, 4)
+        out["train"] = entry
+
+        comm = sum(_vals(steps, "comm_bytes"))
+        peak = max(_vals(steps, "device_peak_bytes") or [0.0])
+        out["resources"] = {
+            "metric": f"{label} comm volume + device memory watermark",
+            "value": round(comm / 1e6, 4),
+            "unit": "MB (total collective bytes, trace-time accounting)",
+            "device_peak_bytes": int(peak),
+            "comm_bytes_total": int(comm),
+        }
+
+    infer = [r for r in records if r.get("kind") == events.INFERENCE]
+    if infer:
+        lat = _robust(_vals(infer, "latency_ms"), trim)
+        tps = _robust(_vals(infer, "tokens_per_sec"), trim)
+        out["inference"] = {
+            "metric": f"{label} serving latency ({len(infer)} requests)",
+            "value": round(lat, 4) if lat is not None else None,
+            "unit": "ms/request",
+            "tokens_per_sec": round(tps, 4) if tps is not None else None,
+            "requests": len(infer),
+        }
+
+    pipe = [r for r in records if r.get("kind") == events.PIPE]
+    if pipe:
+        bf = _vals(pipe, "bubble_fraction")
+        out["pipeline"] = {
+            "metric": f"{label} pipeline bubble fraction "
+                      f"({pipe[-1].get('schedule', '?')})",
+            "value": round(bf[-1], 6) if bf else None,
+            "unit": "fraction of schedule ticks idle",
+            "stages": pipe[-1].get("stages"),
+            "micro_batches": pipe[-1].get("micro_batches"),
+        }
+
+    moe = [r for r in records if r.get("kind") == events.MOE]
+    if moe:
+        drops = _vals(moe, "drop_fraction")
+        out["moe"] = {
+            "metric": f"{label} MoE token drop fraction ({len(moe)} gauges)",
+            "value": round(_robust(drops) or 0.0, 6),
+            "unit": "fraction of routed tokens dropped",
+            "drop_fraction_max": round(max(drops), 6) if drops else None,
+        }
+
+    comms = [r for r in records if r.get("kind") == events.COMM_SUMMARY]
+    if comms:
+        last = comms[-1]
+        out["comms"] = {
+            "metric": f"{label} collective traffic by op",
+            "value": round(float(last.get("total_bytes", 0)) / 1e6, 4),
+            "unit": "MB",
+            "ops": last.get("ops"),
+        }
+
+    return out
+
+
+def fold_file(path: str, label: str = "run", skip_steps: int = 1,
+              trim: float = 0.1) -> Dict[str, Any]:
+    return fold_run(load_records(path), label=label,
+                    skip_steps=skip_steps, trim=trim)

@@ -5,6 +5,13 @@ op appends (op_name, bytes, latency); ``log_all`` prints a summary table.
 On TPU individual collective latency is not observable from Python (ops fuse
 into XLA programs), so the logger records op counts + bytes at trace time
 and per-*step* wall time; algorithmic bandwidth is reported per step.
+
+``log_all`` reports, per (op, size) bucket: count, total bytes, and — when
+latencies were recorded (the onebit host path does) — the trimmed-mean
+latency and the algorithmic bandwidth ``size / latency``.  ``summary()``
+returns the same fold as a structured dict for the telemetry hub
+(``comm_summary`` records), and ``total_bytes()``/``total_ops()`` are the
+cheap cumulative counters the hub snapshots per step.
 """
 
 from deepspeed_tpu.utils.logging import log_dist
@@ -35,6 +42,10 @@ class CommsLogger:
         self.prof_ops = list(getattr(comms_config, "prof_ops", []) or [])
         self.prof_all = getattr(comms_config, "prof_all", True)
         self.enabled = getattr(comms_config, "enabled", True)
+        # running totals: O(1) reads for the telemetry hub's per-step
+        # snapshots (walking comms_dict per step would be O(ops))
+        self._total_bytes = 0
+        self._total_ops = 0
 
     def append(self, record_name: str, msg_size: int, latency: float = 0.0):
         if not self.enabled:
@@ -46,16 +57,63 @@ class CommsLogger:
         stats[0] += 1
         if latency:
             stats[1].append(latency)
+        self._total_bytes += int(msg_size)
+        self._total_ops += 1
         if self.verbose:
             log_dist(f"comm op: {record_name} | msg size: {convert_size(msg_size)}", ranks=[0])
 
-    def log_all(self, print_log=True):
-        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"]
+    def total_bytes(self) -> int:
+        return self._total_bytes
+
+    def total_ops(self) -> int:
+        return self._total_ops
+
+    def summary(self) -> dict:
+        """Structured fold of everything recorded so far — the payload of a
+        telemetry ``comm_summary`` record and the data behind ``log_all``."""
+        from deepspeed_tpu.utils.timer import trim_mean
+        ops = {}
         for record_name, entry in sorted(self.comms_dict.items()):
+            buckets = []
+            for msg_size, (count, lats) in sorted(entry.items()):
+                b = {"msg_size": int(msg_size),
+                     "count": int(count),
+                     "total_bytes": int(msg_size) * int(count)}
+                if lats:
+                    # trimmed mean: compile-step outliers would otherwise
+                    # dominate the reported latency/bandwidth
+                    lat = trim_mean(lats, 0.1)
+                    b["latency_ms"] = lat * 1000.0
+                    b["algbw_gbps"] = (msg_size / max(lat, 1e-12)) / 1e9
+                buckets.append(b)
+            ops[record_name] = {
+                "buckets": buckets,
+                "total_bytes": sum(b["total_bytes"] for b in buckets),
+                "count": sum(b["count"] for b in buckets),
+            }
+        return {"ops": ops, "total_bytes": self._total_bytes,
+                "total_ops": self._total_ops}
+
+    def log_all(self, print_log=True, hub=None, step=None):
+        """Print/return the summary table; with ``hub`` also emit the
+        structured fold as a ``comm_summary`` telemetry record."""
+        s = self.summary()
+        lines = [f"{'Comm. Op':<20}{'Message Size':<16}{'Count':<8}"
+                 f"{'Total Bytes':<14}{'Avg Lat(ms)':<13}{'algbw(GB/s)':<12}"]
+        for record_name, entry in s["ops"].items():
             lines.append(record_name)
-            for msg_size, (count, _lat) in sorted(entry.items()):
-                lines.append(f"{'':<20}{convert_size(msg_size):<20}{count:<10}")
+            for b in entry["buckets"]:
+                lat = f"{b['latency_ms']:.3f}" if "latency_ms" in b else "-"
+                bw = f"{b['algbw_gbps']:.3f}" if "algbw_gbps" in b else "-"
+                lines.append(f"{'':<20}{convert_size(b['msg_size']):<16}"
+                             f"{b['count']:<8}"
+                             f"{convert_size(b['total_bytes']):<14}"
+                             f"{lat:<13}{bw:<12}")
+        lines.append(f"TOTAL: {convert_size(s['total_bytes'])} over "
+                     f"{s['total_ops']} ops")
         summary = "\n".join(lines)
         if print_log:
             log_dist("\n" + summary, ranks=[0])
+        if hub is not None:
+            hub.emit("comm_summary", s, step=step)
         return summary

@@ -20,6 +20,26 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 _FORCED_BACKEND_ENVS = ("JAX_PLATFORMS", "XLA_FLAGS", "_GRAFT_DRYRUN_CHILD")
 
 
+def scan_markers(raw: bytes):
+    """Anchored marker detection: ``(devices_ok, skip)``.
+
+    A marker only counts when it starts its own line — tools print
+    ``DEVICES_OK`` / ``SKIP[: reason]`` as whole lines — so incidental
+    substrings (a traceback mentioning "SKIPPED", a tensor dump containing
+    "DEVICES_OK" mid-line) cannot spuriously claim or skip.  The trailing
+    partial line (no newline yet) is still scanned so a marker is seen the
+    moment it is flushed.
+    """
+    devices_ok = skip = False
+    for line in raw.splitlines():
+        line = line.strip()
+        if line == b"DEVICES_OK":
+            devices_ok = True
+        elif line == b"SKIP" or line.startswith(b"SKIP:") or line.startswith(b"SKIP "):
+            skip = True
+    return devices_ok, skip
+
+
 def run_tpu_tool(tool_name: str, timeout: int = 600):
     """Run ``tools/<tool_name>`` with a clean backend env; assert rc 0 and
     pytest.skip when the tool reports no TPU attached.
@@ -56,6 +76,9 @@ def run_tpu_tool(tool_name: str, timeout: int = 600):
                 proc.wait()
                 raw += proc.stdout.read() or b""   # drain the final flush
                 partial = raw.decode(errors="replace")
+                # re-scan the fully-drained buffer: the SKIP marker may have
+                # arrived in the final flush, after the last in-loop scan
+                _, skip_marker = scan_markers(raw)
                 if claimed and not skip_marker:
                     raise AssertionError(
                         f"{tool_name} hung AFTER acquiring the TPU "
@@ -69,15 +92,16 @@ def run_tpu_tool(tool_name: str, timeout: int = 600):
             # must be seen even when several arrive in one flush
             select.select([proc.stdout], [], [], min(remaining, 5.0))
             raw += proc.stdout.read() or b""
-            if not claimed and (b"DEVICES_OK" in raw or b"SKIP" in raw):
-                claimed = True
-                skip_marker = b"SKIP" in raw
-                deadline = start + timeout   # full budget post-claim
+            if not claimed:
+                devices_ok, skip_marker = scan_markers(raw)
+                if devices_ok or skip_marker:
+                    claimed = True
+                    deadline = start + timeout   # full budget post-claim
     finally:
         proc.stdout.close()
 
     out = raw.decode(errors="replace")
     assert proc.returncode == 0, f"{tool_name} child failed:\n{out}"
-    if "SKIP" in out:
+    if scan_markers(raw)[1]:
         pytest.skip("no TPU attached")
     return out

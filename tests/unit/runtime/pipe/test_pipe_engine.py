@@ -231,6 +231,44 @@ def test_tied_embedding_pipeline_trains(schedule):
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+def test_bubble_fraction_arithmetic_and_telemetry_gauge(tmp_path):
+    """Analytic bubble fractions (gpipe T = M+P-1, 1f1b T = M+2P-1) and the
+    per-train_batch ``pipe`` telemetry record carrying them."""
+    cfg = tiny_cfg(n_layer=2)
+    module = gpt_pipeline_module(cfg, num_stages=2)
+    mesh = MeshSpec(pipe=2, data=4, device_count=8).build(jax.devices()[:8])
+    engine = PipelineEngine(model=module, mesh=mesh, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "pipeline": {"schedule": "gpipe"},
+        "telemetry": {"enabled": True, "jsonl_path": "",
+                      "ring_buffer_size": 16},
+    })
+    # gpipe: T = M + P - 1
+    assert engine.bubble_fraction(4) == pytest.approx(1 - 4 / (4 + 2 - 1))
+    assert engine.bubble_fraction(2) == pytest.approx(1 - 2 / (2 + 1))
+    # more micro-batches amortize the fill/drain bubble
+    assert engine.bubble_fraction(64) < engine.bubble_fraction(2)
+    # 1f1b formula (T = M + 2P - 1), without paying a second engine build:
+    # the arithmetic only consults .schedule and ._adapted.P
+    engine.schedule = "1f1b"
+    assert engine.bubble_fraction(4) == pytest.approx(1 - 4 / (4 + 2 * 2 - 1))
+    assert engine.bubble_fraction(2) == pytest.approx(1 - 2 / (2 + 3))
+    engine.schedule = "gpipe"
+
+    rng = np.random.default_rng(9)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4, 32)), jnp.int32)
+    engine.train_batch(batch=(ids, ids))
+    engine.telemetry_flush()
+    pipe_recs = engine.telemetry.ring.of_kind("pipe")
+    assert len(pipe_recs) == 1
+    rec = pipe_recs[0]
+    assert rec["schedule"] == "gpipe" and rec["stages"] == 2
+    assert rec["micro_batches"] == 2
+    assert rec["bubble_fraction"] == pytest.approx(1 - 2 / 3)
+
+
 def test_micro_api_blocked():
     from deepspeed_tpu.runtime.pipe.engine import PipelineError
     cfg = tiny_cfg(n_layer=2)

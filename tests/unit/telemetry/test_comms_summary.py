@@ -1,0 +1,83 @@
+"""CommsLogger summary fold: total-bytes + bandwidth columns (trim_mean),
+running counters the hub snapshots per step, and emission into the hub."""
+
+import pytest
+
+from deepspeed_tpu.telemetry import RingBufferSink, TelemetryHub
+from deepspeed_tpu.utils.comms_logging import CommsLogger
+
+
+def make_logger(**cfg):
+    from types import SimpleNamespace
+    base = dict(enabled=True, verbose=False, debug=False, prof_ops=[],
+                prof_all=True)
+    base.update(cfg)
+    return CommsLogger(SimpleNamespace(**base))
+
+
+class TestRunningTotals:
+
+    def test_total_bytes_and_ops_accumulate(self):
+        log = make_logger()
+        assert log.total_bytes() == 0 and log.total_ops() == 0
+        log.append("all_reduce", 1024)
+        log.append("all_reduce", 1024)
+        log.append("all_gather", 4096)
+        assert log.total_bytes() == 1024 * 2 + 4096
+        assert log.total_ops() == 3
+
+    def test_disabled_logger_records_nothing(self):
+        log = make_logger(enabled=False)
+        log.append("all_reduce", 1024)
+        assert log.total_bytes() == 0
+
+
+class TestSummaryFold:
+
+    def test_per_op_totals_and_bandwidth(self):
+        log = make_logger()
+        for _ in range(3):
+            log.append("all_reduce", 1 << 20, latency=0.001)   # 1 MB / 1 ms
+        log.append("broadcast", 512)                            # no latency
+        s = log.summary()
+        ar = s["ops"]["all_reduce"]
+        assert ar["count"] == 3
+        assert ar["total_bytes"] == 3 * (1 << 20)
+        bucket = ar["buckets"][0]
+        assert bucket["latency_ms"] == pytest.approx(1.0)
+        # algorithmic bandwidth: 1 MiB / 1 ms ≈ 1.048 GB/s
+        assert bucket["algbw_gbps"] == pytest.approx(1.048576, rel=1e-3)
+        bc = s["ops"]["broadcast"]["buckets"][0]
+        assert "latency_ms" not in bc and "algbw_gbps" not in bc
+        assert s["total_bytes"] == 3 * (1 << 20) + 512
+        assert s["total_ops"] == 4
+
+    def test_trimmed_mean_tames_outliers(self):
+        log = make_logger()
+        # nine 1ms samples + one compile-step 1s outlier
+        for _ in range(9):
+            log.append("all_reduce", 1 << 20, latency=0.001)
+        log.append("all_reduce", 1 << 20, latency=1.0)
+        lat = log.summary()["ops"]["all_reduce"]["buckets"][0]["latency_ms"]
+        assert lat < 5.0, f"outlier dominated the mean: {lat}ms"
+
+
+class TestLogAll:
+
+    def test_table_has_totals_and_bandwidth_columns(self):
+        log = make_logger()
+        log.append("all_reduce", 1 << 20, latency=0.001)
+        table = log.log_all(print_log=False)
+        assert "Total Bytes" in table and "algbw(GB/s)" in table
+        assert "TOTAL: 1.0 MB over 1 ops" in table
+
+    def test_emits_comm_summary_through_hub(self):
+        hub = TelemetryHub(sinks=[RingBufferSink(8)], flush_every=0,
+                           sync_fn=lambda: None, memory_stats_fn=lambda: {})
+        log = make_logger()
+        log.append("all_reduce", 2048)
+        log.log_all(print_log=False, hub=hub, step=5)
+        hub.flush()
+        recs = hub.ring.of_kind("comm_summary")
+        assert len(recs) == 1
+        assert recs[0]["total_bytes"] == 2048 and recs[0]["step"] == 5

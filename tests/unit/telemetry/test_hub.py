@@ -1,0 +1,146 @@
+"""TelemetryHub unit tests: ring-buffer queries, flush cadence (the
+windowed-drain discipline), sink fan-out to the csv monitor, JSONL
+schema header, and comm-byte delta accounting."""
+
+import json
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.monitor.monitor import csvMonitor
+from deepspeed_tpu.telemetry import (JsonlSink, MonitorSink, RingBufferSink,
+                                     TelemetryHub, events)
+
+
+def make_hub(**kw):
+    kw.setdefault("sinks", [RingBufferSink(64)])
+    kw.setdefault("flush_every", 0)          # manual flush unless overridden
+    kw.setdefault("sync_fn", lambda: None)
+    kw.setdefault("memory_stats_fn", lambda: {"peak_bytes_in_use": 1234})
+    return TelemetryHub(**kw)
+
+
+class TestRingBuffer:
+
+    def test_query_by_kind_and_required_fields(self):
+        hub = make_hub(batch_size=16)
+        for s in range(1, 4):
+            hub.record_step(s, loss=jnp.asarray(0.5 * s), grad_norm=jnp.asarray(1.0))
+        hub.emit(events.PIPE, {"bubble_fraction": 0.25}, step=3)
+        hub.flush()
+        ring = hub.ring
+        steps = ring.of_kind(events.STEP)
+        assert len(steps) == 3
+        assert ring.last(events.STEP)["step"] == 3
+        assert len(ring.of_kind(events.PIPE)) == 1
+        for rec in steps:
+            for f in events.STEP_REQUIRED_FIELDS:
+                assert f in rec, f"missing {f}: {rec}"
+            # device arrays must have been resolved to plain host floats
+            assert isinstance(rec["loss"], float)
+            assert rec["device_peak_bytes"] == 1234
+            assert rec["samples_per_sec"] > 0
+
+    def test_capacity_bounded(self):
+        sink = RingBufferSink(capacity=5)
+        hub = make_hub(sinks=[sink])
+        for s in range(20):
+            hub.record_step(s)
+        hub.flush()
+        assert len(sink.records) == 5
+        assert sink.last()["step"] == 19
+
+
+class TestFlushCadence:
+
+    def test_record_step_never_syncs_flush_syncs_once(self):
+        syncs = []
+        sink = RingBufferSink(64)
+        hub = make_hub(sinks=[sink], flush_every=3,
+                       sync_fn=lambda: syncs.append(1))
+        hub.record_step(1)
+        hub.record_step(2)
+        assert not syncs and len(sink.records) == 0  # buffered, no drain
+        hub.record_step(3)                            # window boundary
+        assert len(syncs) == 1 and len(sink.records) == 3
+        hub.record_step(4)
+        assert len(syncs) == 1                        # next window still open
+        hub.close()
+        assert len(syncs) == 2 and len(sink.records) == 4
+        hub.record_step(5)                            # closed hub: dropped
+        assert len(sink.records) == 4
+
+    def test_empty_flush_is_free(self):
+        syncs = []
+        hub = make_hub(sync_fn=lambda: syncs.append(1))
+        hub.flush()
+        assert not syncs
+
+
+class TestMonitorFanout:
+
+    def test_csv_monitor_receives_step_scalars(self, tmp_path):
+        cfg = SimpleNamespace(output_path=str(tmp_path), job_name="job",
+                              monitor_config=None)
+        csv_writer = csvMonitor(cfg)
+        master = SimpleNamespace(write_events=csv_writer.write_events)
+        hub = make_hub(sinks=[MonitorSink(master)], batch_size=8)
+        hub.record_step(1, loss=jnp.asarray(0.75))
+        hub.record_step(2, loss=jnp.asarray(0.5))
+        hub.flush()
+        loss_csv = tmp_path / "job" / "Train_Telemetry_loss.csv"
+        assert loss_csv.exists()
+        rows = loss_csv.read_text().strip().splitlines()
+        assert rows[0].startswith("step,")
+        assert rows[1].split(",") == ["1", "0.75"]
+        assert rows[2].split(",") == ["2", "0.5"]
+        assert (tmp_path / "job" / "Train_Telemetry_samples_per_sec.csv").exists()
+
+
+class TestJsonlSink:
+
+    def test_schema_header_and_appended_records(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        hub = make_hub(sinks=[JsonlSink(str(path))])
+        hub.record_step(1, loss=jnp.asarray(1.0))
+        hub.flush()
+        hub.record_step(2, loss=jnp.asarray(0.5))
+        hub.close()
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert lines[0]["kind"] == events.SCHEMA
+        assert lines[0]["version"] == events.SCHEMA_VERSION
+        assert [l["step"] for l in lines[1:]] == [1, 2]
+        assert all(l["schema"] == events.SCHEMA_VERSION for l in lines)
+
+    def test_non_rank0_writes_nothing(self, tmp_path):
+        path = tmp_path / "r1.jsonl"
+        hub = make_hub(sinks=[JsonlSink(str(path), rank=1)])
+        hub.record_step(1)
+        hub.close()
+        assert not path.exists()
+
+
+class TestCommAccounting:
+
+    def test_comm_bytes_is_per_window_delta(self):
+        logger = SimpleNamespace(_b=100)
+        logger.total_bytes = lambda: logger._b
+        logger.total_ops = lambda: logger._b // 100
+        hub = make_hub(comms_logger=logger)
+        hub.record_step(1)
+        logger._b = 300
+        hub.record_step(2)
+        hub.flush()
+        recs = hub.ring.of_kind(events.STEP)
+        assert recs[0]["comm_bytes"] == 100   # 100 - 0 at hub construction
+        assert recs[1]["comm_bytes"] == 200   # 300 - 100
+        logger._b = 350
+        hub.record_step(3)
+        hub.flush()
+        assert hub.ring.of_kind(events.STEP)[2]["comm_bytes"] == 50
+
+    def test_no_comms_logger_still_has_field(self):
+        hub = make_hub()
+        hub.record_step(1)
+        hub.flush()
+        assert hub.ring.last(events.STEP)["comm_bytes"] == 0

@@ -1,0 +1,79 @@
+"""Expert-load / drop-fraction gauges: pure-metric arithmetic and eager
+emission through a MoE layer into the hub's ring buffer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import expert_load_metrics, top1gating
+from deepspeed_tpu.telemetry import RingBufferSink, TelemetryHub
+
+
+def make_hub():
+    return TelemetryHub(sinks=[RingBufferSink(32)], flush_every=0,
+                        sync_fn=lambda: None, memory_stats_fn=lambda: {})
+
+
+class TestExpertLoadMetrics:
+
+    def test_balanced_no_drop(self):
+        T, E, C = 8, 4, 4
+        exp_counts = jnp.full((E,), T / E)
+        dispatch = jnp.zeros((T, E, C), bool)
+        # every token keeps exactly one slot
+        dispatch = dispatch.at[jnp.arange(T), jnp.arange(T) % E,
+                               jnp.arange(T) // E].set(True)
+        m = expert_load_metrics(exp_counts, dispatch, k=1)
+        assert float(m["drop_fraction"]) == pytest.approx(0.0)
+        assert float(m["load_max"]) == pytest.approx(0.25)
+        assert float(m["load_min"]) == pytest.approx(0.25)
+        assert float(m["load_entropy_frac"]) == pytest.approx(1.0)
+
+    def test_all_on_one_expert_with_drops(self):
+        T, E, C = 8, 4, 2
+        exp_counts = jnp.asarray([8.0, 0.0, 0.0, 0.0])
+        dispatch = jnp.zeros((T, E, C), bool)
+        dispatch = dispatch.at[0, 0, 0].set(True).at[1, 0, 1].set(True)
+        m = expert_load_metrics(exp_counts, dispatch, k=1)
+        # capacity 2 on the hot expert: 6 of 8 routed tokens dropped
+        assert float(m["drop_fraction"]) == pytest.approx(6 / 8)
+        assert float(m["load_max"]) == pytest.approx(1.0)
+
+    def test_consistent_with_real_gating(self):
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+        _, _, dispatch, exp_counts = top1gating(logits, capacity_factor=1.0,
+                                                min_capacity=1)
+        m = expert_load_metrics(exp_counts, dispatch, k=1)
+        kept = float(jnp.sum(dispatch))
+        assert float(m["drop_fraction"]) == pytest.approx(1 - kept / 64)
+        assert 0.0 <= float(m["drop_fraction"]) <= 1.0
+
+
+class TestMoELayerEmission:
+
+    def test_eager_call_emits_gauge(self):
+        hub = make_hub()
+        layer = MoE(hidden_size=16, num_experts=4, expert_hidden=32,
+                    telemetry=hub)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(32, 16)),
+                        jnp.float32)
+        layer(params, x, train=False)
+        hub.flush()
+        recs = hub.ring.of_kind("moe_gauge")
+        assert len(recs) == 1
+        assert 0.0 <= recs[0]["drop_fraction"] <= 1.0
+        assert isinstance(recs[0]["load_max"], float)
+
+    def test_jitted_call_skips_emission(self):
+        hub = make_hub()
+        layer = MoE(hidden_size=16, num_experts=4, expert_hidden=32,
+                    telemetry=hub)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.zeros((32, 16), jnp.float32)
+        jax.jit(lambda p, v: layer(p, v, train=False)[0])(params, x)
+        hub.flush()
+        assert not hub.ring.of_kind("moe_gauge")   # tracers never buffered

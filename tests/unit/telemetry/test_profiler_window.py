@@ -1,0 +1,102 @@
+"""ProfilerWindow state-machine invariants: a trace never starts twice,
+always stops, and the window length is clamped so tracing can never run
+unbounded."""
+
+from types import SimpleNamespace
+
+from deepspeed_tpu.telemetry.profiler import (ACTIVE, DONE, IDLE,
+                                              ProfilerWindow)
+
+
+def make_window(start=2, end=4, **kw):
+    calls = SimpleNamespace(starts=[], stops=0)
+    w = ProfilerWindow(start, end, "/tmp/trace",
+                       start_fn=lambda d: calls.starts.append(d),
+                       stop_fn=lambda: setattr(calls, "stops", calls.stops + 1),
+                       **kw)
+    return w, calls
+
+
+class TestStateMachine:
+
+    def test_starts_once_inside_window_and_stops_at_edge(self):
+        w, calls = make_window(2, 4)
+        w.step_begin(0)
+        assert w.state == IDLE and not calls.starts
+        w.step_begin(2)
+        assert w.state == ACTIVE and calls.starts == ["/tmp/trace"]
+        w.step_begin(3)                       # already active: no restart
+        assert len(calls.starts) == 1
+        w.step_end(3)
+        assert w.state == ACTIVE              # window [2,4) not closed yet
+        w.step_end(4)
+        assert w.state == DONE and calls.stops == 1
+
+    def test_never_starts_twice_even_if_step_reenters_window(self):
+        w, calls = make_window(2, 4)
+        w.step_begin(2)
+        w.step_end(4)
+        w.step_begin(2)                        # counter wrap / re-entry
+        w.step_begin(3)
+        assert w.state == DONE
+        assert len(calls.starts) == 1 and calls.stops == 1
+
+    def test_close_always_stops_an_active_trace(self):
+        w, calls = make_window(2, 100)
+        w.step_begin(5)
+        assert w.state == ACTIVE
+        w.close()
+        assert w.state == DONE and calls.stops == 1
+        w.close()                              # idempotent
+        assert calls.stops == 1
+
+    def test_close_on_idle_or_done_never_calls_stop(self):
+        w, calls = make_window()
+        w.close()
+        assert calls.stops == 0 and w.state == IDLE
+
+    def test_start_failure_poisons_to_done_without_stop(self):
+        calls = SimpleNamespace(stops=0)
+
+        def bad_start(d):
+            raise RuntimeError("no backend")
+
+        w = ProfilerWindow(0, 4, "/tmp/trace", start_fn=bad_start,
+                           stop_fn=lambda: setattr(calls, "stops", calls.stops + 1))
+        w.step_begin(0)
+        assert w.state == DONE and calls.stops == 0
+        w.step_begin(1)                        # stays done, no retry storm
+        assert w.state == DONE
+
+
+class TestUnboundedGuard:
+
+    def test_window_clamped_to_max(self):
+        w, _ = make_window(10, 100000, max_window_steps=8)
+        assert w.end_step == 18
+
+    def test_default_clamp_is_finite(self):
+        w = ProfilerWindow(0, 10**9, "/tmp/trace",
+                           start_fn=lambda d: None, stop_fn=lambda: None)
+        assert w.end_step - w.start_step <= 64
+
+
+class TestFromConfig:
+
+    def _cfg(self, **over):
+        base = dict(profiler_start_step=0, profiler_end_step=0,
+                    profiler_dir="/tmp/t", profiler_max_window_steps=64)
+        base.update(over)
+        return SimpleNamespace(**base)
+
+    def test_disabled_when_no_window(self):
+        assert ProfilerWindow.from_config(self._cfg()) is None
+
+    def test_empty_window_disabled(self):
+        assert ProfilerWindow.from_config(
+            self._cfg(profiler_start_step=5, profiler_end_step=5)) is None
+
+    def test_enabled_window(self):
+        w = ProfilerWindow.from_config(
+            self._cfg(profiler_start_step=3, profiler_end_step=6))
+        assert (w.start_step, w.end_step) == (3, 6)

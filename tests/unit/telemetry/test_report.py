@@ -1,0 +1,115 @@
+"""telemetry_report fold logic + CLI end-to-end on a generated JSONL
+fixture (runs entirely under the session's JAX_PLATFORMS=cpu)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.telemetry import JsonlSink, TelemetryHub, events
+from deepspeed_tpu.telemetry.report import (SchemaError, fold_file, fold_run,
+                                            load_records)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def write_fixture(path, n_steps=6):
+    """Generate a realistic JSONL run through the real hub + sink."""
+    hub = TelemetryHub(sinks=[JsonlSink(str(path))], flush_every=0,
+                       batch_size=32, sync_fn=lambda: None,
+                       memory_stats_fn=lambda: {"peak_bytes_in_use": 4096})
+    for s in range(1, n_steps + 1):
+        hub.record_step(s, loss=2.0 / s, lr=1e-3, grad_norm=1.0)
+    hub.emit(events.PIPE, {"schedule": "1f1b", "stages": 4,
+                           "micro_batches": 8, "bubble_fraction": 0.4667},
+             step=n_steps)
+    hub.emit(events.INFERENCE, {"op": "generate", "latency_ms": 12.5,
+                                "new_tokens": 256, "tokens_per_sec": 20480.0})
+    hub.emit(events.MOE, {"drop_fraction": 0.03, "load_max": 0.4,
+                          "tokens": 512.0})
+    hub.emit(events.COMM_SUMMARY, {"total_bytes": 1 << 20, "total_ops": 7,
+                                   "ops": {"all_reduce": {"count": 7,
+                                                          "total_bytes": 1 << 20}}})
+    hub.close()
+    return path
+
+
+class TestFold:
+
+    def test_bench_shaped_summary(self, tmp_path):
+        path = write_fixture(tmp_path / "fix.jsonl")
+        summary = fold_file(str(path), label="toy")
+        # BENCH_DETAIL shape: named entries with metric/value/unit
+        for key in ("train", "resources", "inference", "pipeline", "moe",
+                    "comms"):
+            assert key in summary, summary.keys()
+            assert "metric" in summary[key] and "unit" in summary[key]
+        t = summary["train"]
+        assert t["value"] > 0 and t["unit"] == "samples/sec"
+        assert t["steps"] == 6
+        assert t["loss"] == pytest.approx(2.0 / 6, rel=1e-4)
+        assert t["loss_first"] == pytest.approx(2.0, rel=1e-4)
+        assert summary["pipeline"]["value"] == pytest.approx(0.4667)
+        assert summary["inference"]["tokens_per_sec"] == pytest.approx(20480.0)
+        assert summary["resources"]["device_peak_bytes"] == 4096
+        json.dumps(summary)   # must be valid JSON end to end
+
+    def test_warmup_steps_dropped_from_rates(self):
+        recs = []
+        for s in range(1, 5):
+            recs.append({"kind": "step", "schema": 1, "step": s, "loss": 1.0,
+                         "lr": 0.1, "step_time_ms": 1000.0 if s == 1 else 10.0,
+                         "samples_per_sec": 1.0 if s == 1 else 100.0,
+                         "comm_bytes": 0, "device_peak_bytes": 0})
+        out = fold_run(recs, skip_steps=1, trim=0.0)
+        assert out["train"]["value"] == pytest.approx(100.0)
+        assert out["train"]["step_time_ms"] == pytest.approx(10.0)
+
+    def test_schema_version_mismatch_rejected(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text(json.dumps({"kind": "schema", "schema": 99,
+                                 "version": 99}) + "\n")
+        with pytest.raises(SchemaError):
+            load_records(str(p))
+
+    def test_malformed_line_rejected(self, tmp_path):
+        p = tmp_path / "junk.jsonl"
+        p.write_text('{"kind": "step"}\nnot json at all\n')
+        with pytest.raises(SchemaError):
+            load_records(str(p))
+
+
+class TestCli:
+
+    def _cli(self):
+        spec = importlib.util.spec_from_file_location(
+            "telemetry_report", os.path.join(REPO_ROOT, "tools",
+                                             "telemetry_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_end_to_end_writes_bench_json(self, tmp_path):
+        fixture = write_fixture(tmp_path / "run.jsonl")
+        out = tmp_path / "BENCH_run.json"
+        rc = self._cli().main([str(fixture), "-o", str(out), "--label", "e2e"])
+        assert rc == 0
+        summary = json.loads(out.read_text())
+        assert summary["train"]["value"] > 0
+        assert "e2e" in summary["train"]["metric"]
+
+    def test_stdout_mode(self, tmp_path, capsys):
+        fixture = write_fixture(tmp_path / "run.jsonl")
+        rc = self._cli().main([str(fixture)])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["train"]["steps"] == 6
+
+    def test_bad_schema_exits_nonzero(self, tmp_path, capsys):
+        p = tmp_path / "bad.jsonl"
+        p.write_text(json.dumps({"kind": "step", "schema": 42}) + "\n")
+        assert self._cli().main([str(p)]) == 1
+
+    def test_missing_file_exits_nonzero(self, tmp_path):
+        assert self._cli().main([str(tmp_path / "nope.jsonl")]) == 1

@@ -1,0 +1,26 @@
+"""dst-ssh host-key policy: accept-new by default, blanket-disable only
+behind the explicit flag/env escape hatch."""
+
+from deepspeed_tpu.cli_utils import _host_key_checking_mode
+
+
+def test_default_is_accept_new(monkeypatch):
+    monkeypatch.delenv("DST_SSH_INSECURE_HOST_KEYS", raising=False)
+    assert _host_key_checking_mode(False) == "accept-new"
+
+
+def test_flag_disables_checking(monkeypatch):
+    monkeypatch.delenv("DST_SSH_INSECURE_HOST_KEYS", raising=False)
+    assert _host_key_checking_mode(True) == "no"
+
+
+def test_env_var_disables_checking(monkeypatch):
+    for val in ("1", "true", "yes"):
+        monkeypatch.setenv("DST_SSH_INSECURE_HOST_KEYS", val)
+        assert _host_key_checking_mode(False) == "no"
+
+
+def test_env_var_falsy_values_stay_secure(monkeypatch):
+    for val in ("", "0", "false", "off"):
+        monkeypatch.setenv("DST_SSH_INSECURE_HOST_KEYS", val)
+        assert _host_key_checking_mode(False) == "accept-new"

@@ -59,3 +59,58 @@ def test_child_failure_raises(tmp_path, monkeypatch):
     """, monkeypatch)
     with pytest.raises(AssertionError, match="child failed"):
         common.run_tpu_tool(name, timeout=30)
+
+
+def test_scan_markers_anchored():
+    """Markers must start their own line; substrings elsewhere don't count."""
+    assert common.scan_markers(b"DEVICES_OK\n") == (True, False)
+    assert common.scan_markers(b"SKIP: no TPU attached\n") == (False, True)
+    assert common.scan_markers(b"SKIP\n") == (False, True)
+    assert common.scan_markers(b"  DEVICES_OK  \n") == (True, False)
+    # trailing partial line (no newline yet) still counts
+    assert common.scan_markers(b"noise\nDEVICES_OK") == (True, False)
+    # mid-line / embedded mentions are NOT markers
+    assert common.scan_markers(b"log: DEVICES_OK seen in dump\n") == (False, False)
+    assert common.scan_markers(b"3 tests SKIPPED\n") == (False, False)
+    assert common.scan_markers(b"SKIPPED: unrelated\n") == (False, False)
+    assert common.scan_markers(b"warn: use --SKIP flag\n") == (False, False)
+
+
+def test_incidental_skip_substring_does_not_skip(tmp_path, monkeypatch):
+    """A traceback/log line mentioning SKIPPED mid-run must not convert a
+    healthy pass into a skip (the old raw substring scan did)."""
+    name = _tool(tmp_path, """
+        print("collected 3 items / 2 SKIPPED earlier", flush=True)
+        print("DEVICES_OK", flush=True)
+        print("PASS")
+    """, monkeypatch)
+    out = common.run_tpu_tool(name, timeout=30)
+    assert "PASS" in out
+
+
+def test_embedded_devices_ok_is_not_a_claim(tmp_path, monkeypatch):
+    """DEVICES_OK inside a longer line must not count as the claim marker:
+    a tool that then wedges is an unclaimed pool skip, not a post-claim
+    kernel-hang failure."""
+    name = _tool(tmp_path, """
+        import time
+        print("log: DEVICES_OK appeared inside a dump line", flush=True)
+        time.sleep(60)
+    """, monkeypatch)
+    with pytest.raises(pytest.skip.Exception, match="claim never completed"):
+        common.run_tpu_tool(name, timeout=6)
+
+
+def test_timeout_branch_rescans_for_late_skip(tmp_path, monkeypatch):
+    """SKIP printed after the claim (teardown path) arrives after the loop
+    stopped scanning; the timeout branch must re-scan the drained buffer
+    and skip instead of reporting a post-claim hang."""
+    name = _tool(tmp_path, """
+        import time
+        print("DEVICES_OK", flush=True)
+        time.sleep(1)
+        print("SKIP: TPU lost during teardown", flush=True)
+        time.sleep(60)
+    """, monkeypatch)
+    with pytest.raises(pytest.skip.Exception, match="teardown"):
+        common.run_tpu_tool(name, timeout=6)

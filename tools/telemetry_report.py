@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Fold a telemetry JSONL run into a BENCH_*.json-shaped summary.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/telemetry_report.py run.jsonl \
+        [-o BENCH_run.json] [--label gpt2-train] [--skip-steps 1] [--trim 0.1]
+
+Reads the JSONL emitted by the TelemetryHub's JsonlSink (schema-checked),
+computes trimmed-mean steady-state rates, and writes/prints a summary dict
+shaped like the repo's BENCH_DETAIL_*.json files so perf PRs can diff
+trajectories directly.  Runs anywhere — the fold touches no accelerator.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="telemetry_report",
+        description="fold a telemetry JSONL run into a BENCH-shaped summary")
+    parser.add_argument("jsonl", help="telemetry JSONL file (JsonlSink output)")
+    parser.add_argument("-o", "--output", default="",
+                        help="write the summary JSON here (default: stdout)")
+    parser.add_argument("--label", default="run",
+                        help="run label used in metric descriptions")
+    parser.add_argument("--skip-steps", type=int, default=1,
+                        help="warm-up steps dropped from steady-state rates")
+    parser.add_argument("--trim", type=float, default=0.1,
+                        help="two-sided trim fraction for robust means")
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.telemetry.report import SchemaError, fold_file
+    try:
+        summary = fold_file(args.jsonl, label=args.label,
+                            skip_steps=args.skip_steps, trim=args.trim)
+    except (SchemaError, FileNotFoundError) as e:
+        print(f"telemetry_report: {e}", file=sys.stderr)
+        return 1
+    if not summary:
+        print(f"telemetry_report: no foldable records in {args.jsonl}",
+              file=sys.stderr)
+        return 1
+
+    text = json.dumps(summary, indent=1)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.output}")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
