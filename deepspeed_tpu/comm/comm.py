@@ -23,6 +23,7 @@ drives several chips).
 """
 
 import os
+from contextlib import contextmanager
 from dataclasses import dataclass
 from enum import Enum
 from typing import Optional, Sequence, Union
@@ -31,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deepspeed_tpu.telemetry.tracing import get_global_tracer
 from deepspeed_tpu.utils.logging import logger
 
 AxisNames = Union[str, Sequence[str]]
@@ -145,8 +147,8 @@ def broadcast_object_list(objs, src: int = 0, group=None):
 
 
 # --------------------------------------------------------------------------- #
-# CommsLogger hook — records (op, bytes) at trace time; wall-clock timing is
-# attached at the step level since ops fuse inside XLA.
+# CommsLogger + tracer hook — records (op, bytes) at trace time; wall-clock
+# timing is attached at the step level since ops fuse inside XLA.
 # --------------------------------------------------------------------------- #
 @dataclass
 class _CommRecord:
@@ -160,13 +162,27 @@ def configure_comms_logger(comms_logger):
     _COMMS_LOGGER = comms_logger
 
 
-def _log_op(name: str, tensor):
+@contextmanager
+def _log_op(name: str, tensor, group=None):
+    """Per-collective instrumentation: appends (op, bytes) to the
+    CommsLogger and opens a ``comm.<op>`` span tagged {op, axis, bytes}
+    on the global tracer.  Both fire at *trace* time — the op itself
+    fuses into the XLA program, so the span marks when the collective was
+    staged (and, via jax.named_scope, names it in device profiles); run
+    time shows up in the profiler capture, not here."""
+    try:
+        nbytes = tensor.size * tensor.dtype.itemsize
+    except Exception:
+        nbytes = 0
     if _COMMS_LOGGER is not None:
-        try:
-            nbytes = tensor.size * tensor.dtype.itemsize
-        except Exception:
-            nbytes = 0
         _COMMS_LOGGER.append(name, nbytes)
+    tracer = get_global_tracer()
+    if tracer is None:
+        yield
+        return
+    axis = group if isinstance(group, (str, type(None))) else "+".join(group)
+    with tracer.span(f"comm.{name}", op=name, axis=axis, bytes=nbytes):
+        yield
 
 
 # --------------------------------------------------------------------------- #
@@ -175,66 +191,68 @@ def _log_op(name: str, tensor):
 def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisNames = "data", **kw):
     """Reduce across a mesh axis (reference ``comm/comm.py:all_reduce:214``
     → here an XLA ``psum``/``pmin``/``pmax`` over ICI)."""
-    _log_op("all_reduce", tensor)
-    if op in (ReduceOp.SUM, ReduceOp.AVG):
-        out = lax.psum(tensor, group)
-        if op == ReduceOp.AVG:
-            out = out / get_axis_size(group)
-        return out
-    if op == ReduceOp.MIN:
-        return lax.pmin(tensor, group)
-    if op == ReduceOp.MAX:
-        return lax.pmax(tensor, group)
-    if op == ReduceOp.PRODUCT:
-        # No pprod primitive; reconstruct from log-magnitude + sign parity
-        # so negatives and zeros reduce correctly.
-        safe = jnp.where(tensor == 0, jnp.ones_like(tensor), jnp.abs(tensor))
-        mag = jnp.exp(lax.psum(jnp.log(safe), group))
-        neg = lax.psum((tensor < 0).astype(jnp.int32), group)
-        any_zero = lax.pmax((tensor == 0).astype(jnp.int32), group)
-        sign = jnp.where(neg % 2 == 1, -1.0, 1.0)
-        return jnp.where(any_zero == 1, jnp.zeros_like(mag), sign * mag)
-    raise ValueError(f"unsupported reduce op {op}")
+    with _log_op("all_reduce", tensor, group):
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = lax.psum(tensor, group)
+            if op == ReduceOp.AVG:
+                out = out / get_axis_size(group)
+            return out
+        if op == ReduceOp.MIN:
+            return lax.pmin(tensor, group)
+        if op == ReduceOp.MAX:
+            return lax.pmax(tensor, group)
+        if op == ReduceOp.PRODUCT:
+            # No pprod primitive; reconstruct from log-magnitude + sign parity
+            # so negatives and zeros reduce correctly.
+            safe = jnp.where(tensor == 0, jnp.ones_like(tensor), jnp.abs(tensor))
+            mag = jnp.exp(lax.psum(jnp.log(safe), group))
+            neg = lax.psum((tensor < 0).astype(jnp.int32), group)
+            any_zero = lax.pmax((tensor == 0).astype(jnp.int32), group)
+            sign = jnp.where(neg % 2 == 1, -1.0, 1.0)
+            return jnp.where(any_zero == 1, jnp.zeros_like(mag), sign * mag)
+        raise ValueError(f"unsupported reduce op {op}")
 
 
 def all_gather(tensor, group: AxisNames = "data", axis: int = 0, tiled: bool = True):
     """Gather shards along ``axis`` across a mesh axis (reference
     ``all_gather_into_tensor``, ``comm/comm.py:308``)."""
-    _log_op("all_gather", tensor)
-    return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
+    with _log_op("all_gather", tensor, group):
+        return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisNames = "data",
                    scatter_dimension: int = 0):
     """Reduce then scatter along ``scatter_dimension`` (reference
     ``reduce_scatter_tensor``, ``comm/comm.py:239``)."""
-    _log_op("reduce_scatter", tensor)
-    out = lax.psum_scatter(tensor, group, scatter_dimension=scatter_dimension, tiled=True)
-    if op == ReduceOp.AVG:
-        out = out / get_axis_size(group)
-    return out
+    with _log_op("reduce_scatter", tensor, group):
+        out = lax.psum_scatter(tensor, group, scatter_dimension=scatter_dimension,
+                               tiled=True)
+        if op == ReduceOp.AVG:
+            out = out / get_axis_size(group)
+        return out
 
 
 def all_to_all(tensor, group: AxisNames = "expert", split_axis: int = 0, concat_axis: int = 0):
     """All-to-all over a mesh axis (reference ``all_to_all_single``; MoE
     dispatch ``moe/sharded_moe.py:_AllToAll:90``)."""
-    _log_op("all_to_all", tensor)
-    return lax.all_to_all(tensor, group, split_axis=split_axis, concat_axis=concat_axis,
-                          tiled=True)
+    with _log_op("all_to_all", tensor, group):
+        return lax.all_to_all(tensor, group, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(tensor, src: int = 0, group: AxisNames = "data"):
     """Broadcast the ``src`` shard's value to all members of the axis."""
-    _log_op("broadcast", tensor)
-    idx = lax.axis_index(group)
-    return lax.psum(jnp.where(idx == src, tensor, jnp.zeros_like(tensor)), group)
+    with _log_op("broadcast", tensor, group):
+        idx = lax.axis_index(group)
+        return lax.psum(jnp.where(idx == src, tensor, jnp.zeros_like(tensor)),
+                        group)
 
 
 def ppermute(tensor, perm, group: AxisNames = "pipe"):
     """Point-to-point ring shift — the pipeline P2P primitive (reference
     ``pipe/p2p.py:50,71``; here one XLA ``ppermute`` over the pipe axis)."""
-    _log_op("ppermute", tensor)
-    return lax.ppermute(tensor, group, perm)
+    with _log_op("ppermute", tensor, group):
+        return lax.ppermute(tensor, group, perm)
 
 
 def send_recv_next(tensor, group: AxisNames = "pipe"):

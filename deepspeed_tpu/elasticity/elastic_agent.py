@@ -48,18 +48,38 @@ class DSElasticAgent:
 
     def __init__(self, spec: WorkerSpec, ds_config: Optional[Dict] = None,
                  max_restarts: int = 3, monitor_interval: float = 1.0,
-                 world_size_fn: Optional[Callable[[], int]] = None):
+                 world_size_fn: Optional[Callable[[], int]] = None,
+                 telemetry=None):
         """``world_size_fn`` reports the currently-available world size
         (pod metadata / scheduler probe); a change triggers a restart with
-        a re-solved elastic batch config."""
+        a re-solved elastic batch config.  ``telemetry`` (a TelemetryHub)
+        receives a structured ``worker_exit`` record for every worker-group
+        exit — failure, membership change, clean finish, or give-up — so
+        restarts leave an audit trail instead of happening silently."""
         self.spec = spec
         self.ds_config = ds_config or {}
         self.max_restarts = max_restarts
         self.monitor_interval = monitor_interval
         self.world_size_fn = world_size_fn or (lambda: 1)
+        self.telemetry = telemetry
         self.restart_count = 0
         self._proc: Optional[subprocess.Popen] = None
         self._world = None
+
+    def _emit_worker_exit(self, exit_code, reason: str):
+        if self.telemetry is None:
+            return
+        try:
+            self.telemetry.emit("worker_exit", {
+                "exit_code": exit_code,
+                "reason": reason,
+                "restart_count": self.restart_count,
+                "world_size": self._world,
+                "pid": self._proc.pid if self._proc is not None else None,
+            })
+            self.telemetry.flush()
+        except Exception as e:
+            logger.warning(f"elastic agent: worker_exit emission failed: {e}")
 
     # ------------------------------------------------------------------ #
     def _elastic_env(self, world: int) -> Dict[str, str]:
@@ -84,21 +104,48 @@ class DSElasticAgent:
         log_dist(f"elastic agent: started workers (pid {self._proc.pid}, "
                  f"world {world})", ranks=[0])
 
-    def _stop(self):
-        if self._proc is None or self._proc.poll() is not None:
-            return
-        try:   # kill the whole process group (launcher children included)
-            os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
-        except ProcessLookupError:
-            pass
+    def _stop(self, reason: str = "stop", timeout: float = 15.0):
+        """Terminate and REAP the whole worker process group, then emit a
+        structured ``worker_exit`` record.  Returns the group leader's
+        exit code (None if it had already been collected).
+
+        Reaping matters: the launcher's children share the leader's
+        process group (``start_new_session=True``), and without an
+        explicit ``waitpid`` sweep over ``-pgid`` they linger as zombies
+        across restarts until the agent itself exits."""
+        if self._proc is None:
+            return None
+        rc = self._proc.poll()
         try:
-            self._proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
+            pgid = os.getpgid(self._proc.pid)
+        except ProcessLookupError:
+            pgid = self._proc.pid
+        if rc is None:
+            try:   # kill the whole process group (launcher children incl.)
+                os.killpg(pgid, signal.SIGTERM)
             except ProcessLookupError:
                 pass
-            self._proc.wait()
+            try:
+                rc = self._proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(pgid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                rc = self._proc.wait()
+        # sweep the rest of the group (scoped to -pgid: never steal other
+        # children of this process)
+        while True:
+            try:
+                pid, _status = os.waitpid(-pgid, os.WNOHANG)
+            except ChildProcessError:
+                break
+            except OSError:
+                break
+            if pid == 0:
+                break
+        self._emit_worker_exit(rc, reason)
+        return rc
 
     # ------------------------------------------------------------------ #
     def run(self, max_steps: Optional[int] = None) -> int:
@@ -112,16 +159,20 @@ class DSElasticAgent:
             ticks += 1
             rc = self._proc.poll()
             if rc is not None:
+                # leader already exited — _stop degrades to reap-and-emit
                 if rc == 0:
                     log_dist("elastic agent: workers finished", ranks=[0])
+                    self._stop(reason="clean_exit")
                     return 0
                 if self.restart_count >= self.max_restarts:
                     logger.error(f"elastic agent: giving up after "
                                  f"{self.restart_count} restarts (rc={rc})")
+                    self._stop(reason="max_restarts_exceeded")
                     return rc
                 self.restart_count += 1
                 log_dist(f"elastic agent: worker failure rc={rc} — restart "
                          f"{self.restart_count}/{self.max_restarts}", ranks=[0])
+                self._stop(reason="worker_failure")
                 self._start(self.world_size_fn())
                 continue
             world = self.world_size_fn()
@@ -130,8 +181,8 @@ class DSElasticAgent:
                 # re-solved batch config; checkpoints reshard on resume
                 log_dist(f"elastic agent: membership {self._world} -> {world}; "
                          f"restarting", ranks=[0])
-                self._stop()
+                self._stop(reason=f"membership_change:{self._world}->{world}")
                 self._start(world)
             if max_steps is not None and ticks >= max_steps:
-                self._stop()
+                self._stop(reason="max_steps")
                 return 0

@@ -22,6 +22,7 @@ and optionally captures CUDA graphs.  TPU-native redesign:
 """
 
 import time
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -31,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.telemetry.tracing import get_global_tracer
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -38,11 +40,14 @@ class InferenceEngine:
 
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
                  params=None, mesh=None, seed: int = 0, policy=None,
-                 telemetry=None):
+                 telemetry=None, tracer=None):
         self._config = config or DeepSpeedInferenceConfig()
         # per-request latency/throughput records; None (the default) keeps
         # serving fully async — no block_until_ready is ever issued
         self.telemetry = telemetry
+        # span tracing; None falls back to the process-global tracer (set
+        # by a co-resident training engine or by the serving harness)
+        self.tracer = tracer
         self._request_count = 0
         self.dtype = self._config.jnp_dtype
         # dtype="int8" means weight-only int8 (reference quantizes injected
@@ -142,13 +147,20 @@ class InferenceEngine:
         return self
 
     # ------------------------------------------------------------------ #
+    def _span(self, name, **args):
+        tr = self.tracer if self.tracer is not None else get_global_tracer()
+        return tr.span(name, **args) if tr is not None else nullcontext()
+
     def _record_request(self, op, t0, out, new_tokens=0):
         """Per-request telemetry record.  Blocks on the request's own output
         (not the whole device) to get a true end-to-end latency; compiled
         here means telemetry-off serving never blocks at all."""
         if self.telemetry is None:
             return out
-        jax.block_until_ready(out)
+        # the decode span covers device-side token generation: it opens at
+        # dispatch return and closes when the request's output is ready
+        with self._span("inference.decode", op=op, new_tokens=new_tokens):
+            jax.block_until_ready(out)
         dt = max(time.perf_counter() - t0, 1e-9)
         rec = {"op": op, "latency_ms": dt * 1000.0}
         if hasattr(out, "shape") and getattr(out, "ndim", 0) >= 1:
@@ -190,8 +202,10 @@ class InferenceEngine:
         mask = (jnp.asarray(attention_mask) if attention_mask is not None
                 else jnp.ones_like(input_ids))
         t0 = time.perf_counter()
-        out = self._forward_fn(self.params, input_ids, mask)
-        return self._record_request("forward", t0, out)
+        with self._span("inference.forward", batch=int(input_ids.shape[0]),
+                        seq=int(input_ids.shape[1])):
+            out = self._forward_fn(self.params, input_ids, mask)
+            return self._record_request("forward", t0, out)
 
     __call__ = forward
 
@@ -231,12 +245,19 @@ class InferenceEngine:
                 self._generate_fns[key] = jax.jit(gen)
             r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
             t0 = time.perf_counter()
-            out = self._generate_fns[key](self.params, ids,
-                                          jnp.asarray(S, jnp.int32), r)
-            # drop the pad tail: [prompt | pad | new] -> [prompt | new]
-            out = jnp.concatenate([out[:, :S], out[:, S_pad:]], axis=1)
-            return self._record_request("generate", t0, out,
-                                        new_tokens=B * max_new_tokens)
+            with self._span("inference.generate", batch=B, prompt_len=S,
+                            max_new_tokens=max_new_tokens, bucketed=True):
+                # prefill = host-side staging/dispatch of the fused
+                # prefill+decode program; device-side completion is the
+                # decode span inside _record_request
+                with self._span("inference.prefill", batch=B, prompt_len=S,
+                                bucket=S_pad):
+                    out = self._generate_fns[key](self.params, ids,
+                                                  jnp.asarray(S, jnp.int32), r)
+                # drop the pad tail: [prompt | pad | new] -> [prompt | new]
+                out = jnp.concatenate([out[:, :S], out[:, S_pad:]], axis=1)
+                return self._record_request("generate", t0, out,
+                                            new_tokens=B * max_new_tokens)
         key = (input_ids.shape, max_new_tokens, float(temperature))
         if key not in self._generate_fns:
             def gen(params, ids, r):
@@ -246,9 +267,12 @@ class InferenceEngine:
             self._generate_fns[key] = jax.jit(gen)
         r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
         t0 = time.perf_counter()
-        out = self._generate_fns[key](self.params, input_ids, r)
-        return self._record_request("generate", t0, out,
-                                    new_tokens=B * max_new_tokens)
+        with self._span("inference.generate", batch=B, prompt_len=S,
+                        max_new_tokens=max_new_tokens, bucketed=False):
+            with self._span("inference.prefill", batch=B, prompt_len=S):
+                out = self._generate_fns[key](self.params, input_ids, r)
+            return self._record_request("generate", t0, out,
+                                        new_tokens=B * max_new_tokens)
 
 
 def init_inference(model=None, config=None, **kwargs):
@@ -262,6 +286,7 @@ def init_inference(model=None, config=None, **kwargs):
     # "telemetry" is either a TelemetryHub instance (shared with a training
     # engine) or a telemetry config dict to build a standalone hub from
     telemetry = cfg_dict.pop("telemetry", None)
+    tracer = cfg_dict.pop("tracer", None)
     if isinstance(telemetry, dict):
         from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
         from deepspeed_tpu.telemetry import TelemetryHub
@@ -269,4 +294,4 @@ def init_inference(model=None, config=None, **kwargs):
         telemetry = TelemetryHub.from_config(tcfg) if tcfg.enabled else None
     ds_config = DeepSpeedInferenceConfig(**cfg_dict)
     return InferenceEngine(model, config=ds_config, params=params, mesh=mesh,
-                           policy=policy, telemetry=telemetry)
+                           policy=policy, telemetry=telemetry, tracer=tracer)

@@ -64,6 +64,14 @@ class WandbMonitor(Monitor):
 
 class csvMonitor(Monitor):
 
+    # event tags become filenames and header cells: strip path separators,
+    # and keep commas/newlines out of the header (a tag like
+    # "Train/loss,clipped" must not add a phantom CSV column)
+    @staticmethod
+    def _sanitize_tag(name: str) -> str:
+        return (str(name).replace("/", "_").replace(",", "_")
+                .replace("\n", "_").replace("\r", "_"))
+
     def __init__(self, cfg):
         super().__init__(cfg)
         self.output_path = cfg.output_path or "./csv_monitor"
@@ -73,14 +81,18 @@ class csvMonitor(Monitor):
 
     def write_events(self, event_list):
         import csv
+        out_dir = os.path.join(self.output_path, self.job_name)
+        # the directory can vanish mid-run (tmp cleaners, log rotation);
+        # recreate rather than crash the training loop
+        os.makedirs(out_dir, exist_ok=True)
         for name, value, step in event_list:
-            fname = os.path.join(self.output_path, self.job_name,
-                                 name.replace("/", "_") + ".csv")
+            tag = self._sanitize_tag(name)
+            fname = os.path.join(out_dir, tag + ".csv")
             new = not os.path.exists(fname)
             with open(fname, "a", newline="") as f:
                 w = csv.writer(f)
                 if new:
-                    w.writerow(["step", name])
+                    w.writerow(["step", tag])
                 w.writerow([step, float(value)])
 
 

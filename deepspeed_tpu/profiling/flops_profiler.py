@@ -213,6 +213,21 @@ class FlopsProfiler:
                 self._tables[depth] = []
         return self._tables.get(depth, [])[:top_modules]
 
+    def breakdown_payload(self, module_depth=-1, top_modules=20):
+        """Cost table as a flat JSON-ready payload — emitted once through
+        the TelemetryHub as a ``flops_breakdown`` record so span timelines
+        carry FLOPs attribution (tools/trace_merge.py folds it in)."""
+        return {
+            "flops_per_step": float(self.flops_per_step or 0.0),
+            "latency_s": float(self.latency),
+            "modules": [
+                {"scope": scope, "op": prim, "flops": int(flops),
+                 "calls": int(calls)}
+                for scope, prim, flops, calls in self.module_table(
+                    module_depth=module_depth, top_modules=top_modules)
+            ],
+        }
+
     def print_model_profile(self, profile_step=1, module_depth=-1, top_modules=3,
                             detailed=True, output_file=None):
         lines = [f"flops per step: {self.get_total_flops(True)}, "

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.telemetry.tracing import maybe_span
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
@@ -64,9 +65,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     os.makedirs(save_dir, exist_ok=True)
 
     ce = _ckpt_engine(engine)
-    ce.create(tag)
-    state_path = os.path.join(ckpt_dir, "state")
-    ce.save(_engine_tree(engine), state_path)
+    with maybe_span("checkpoint.save", tag=tag, dir=save_dir):
+        ce.create(tag)
+        state_path = os.path.join(ckpt_dir, "state")
+        ce.save(_engine_tree(engine), state_path)
 
     meta = {
         "global_steps": engine.global_steps,
@@ -149,7 +151,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         "scaler": jax.tree.map(_abstract_leaf_replicated(engine), engine.state.scaler._asdict()),
         "skipped": _abstract_leaf_replicated(engine)(engine.state.skipped),
     }
-    restored = _ckpt_engine(engine).load(state_path, target=target)
+    with maybe_span("checkpoint.load", tag=str(tag), dir=load_dir):
+        restored = _ckpt_engine(engine).load(state_path, target=target)
 
     engine.state.params = restored["params"]
     if load_optimizer_states and not load_module_only:

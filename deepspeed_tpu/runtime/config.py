@@ -86,6 +86,17 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigModel):
     profiler_end_step: int = 0           # 0 → profiler disabled
     profiler_dir: str = "/tmp/deepspeed_tpu_trace"
     profiler_max_window_steps: int = 64  # unbounded-trace guard
+    # span tracing (Chrome-trace export per rank; tools/trace_merge.py
+    # folds rank files onto one timeline)
+    tracing: bool = False
+    trace_dir: str = ""                  # "" → no export on close
+    trace_buffer_size: int = 65536       # completed-span ring capacity
+    # hang watchdog + flight recorder
+    watchdog_enabled: bool = False
+    watchdog_timeout_s: float = 120.0    # stall threshold (monotonic)
+    watchdog_poll_s: float = 0.0         # 0 → timeout/4, clamped [0.5, 10]s
+    watchdog_signal_dump: bool = True    # dump on SIGTERM/SIGABRT too
+    flight_recorder_dir: str = "/tmp/deepspeed_tpu_flight"
 
 
 class DeepSpeedActivationCheckpointingConfig(DeepSpeedConfigModel):

@@ -33,6 +33,7 @@ TPU-first redesign:
 """
 
 import os
+from contextlib import nullcontext
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
@@ -210,6 +211,36 @@ class DeepSpeedEngine:
                 batch_size=self.train_batch_size(),
                 steps_per_print=self._config.steps_per_print)
             self.profiler_window = ProfilerWindow.from_config(tcfg)
+
+        # ---- span tracing + hang watchdog / flight recorder ------------ #
+        # The tracer registers globally so the comm facade and
+        # checkpointing annotate spans without holding an engine ref; the
+        # watchdog is petted by every span via the tracer heartbeat hook.
+        self.tracer = None
+        self.watchdog = None
+        self.flight_recorder = None
+        self._flops_breakdown_emitted = False
+        if tcfg.enabled and (tcfg.tracing or tcfg.watchdog_enabled):
+            from deepspeed_tpu.telemetry import (FlightRecorder, HangWatchdog,
+                                                 Tracer, set_global_tracer)
+            rank = dist.get_rank()
+            if tcfg.watchdog_enabled:
+                self.watchdog = HangWatchdog(
+                    timeout_s=tcfg.watchdog_timeout_s,
+                    poll_s=tcfg.watchdog_poll_s)
+            if tcfg.tracing or tcfg.watchdog_enabled:
+                self.tracer = Tracer(
+                    rank=rank, capacity=tcfg.trace_buffer_size,
+                    heartbeat=self.watchdog.pet if self.watchdog else None)
+                set_global_tracer(self.tracer)
+            if self.watchdog is not None:
+                self.flight_recorder = FlightRecorder(
+                    tcfg.flight_recorder_dir, rank=rank,
+                    hub=self.telemetry, tracer=self.tracer)
+                self.watchdog.on_stall = self.flight_recorder.on_stall
+                if tcfg.watchdog_signal_dump:
+                    self.watchdog.install_signal_handlers()
+                self.watchdog.start()
 
         # progressive layer drop
         self.progressive_layer_drop = None
@@ -1021,30 +1052,35 @@ class DeepSpeedEngine:
         if self._in_training_mode and self.profiler_window is not None:
             self.profiler_window.step_begin(self.global_steps)
         self.timers(FORWARD_MICRO_TIMER).start(sync=False)
+        if self.watchdog is not None:
+            self.watchdog.arm(f"fwd step={self.global_steps}")
 
-        if self._in_training_mode:
-            if self._onebit_active():
-                # post-freeze 1-bit path: gradients stay per-device here and
-                # travel compressed at the gas boundary (step())
-                if self._grad_step_local is None:
-                    self._grad_step_local = self._build_grad_step_local(batch)
-                loss, grads = self._grad_step_local(
-                    self.state.params, batch, self._next_rng(),
-                    self.state.scaler.scale)
-                self._grads_are_local = True
+        with self._span("fwd", step=self.global_steps,
+                        micro_step=self.micro_steps):
+            if self._in_training_mode:
+                if self._onebit_active():
+                    # post-freeze 1-bit path: gradients stay per-device here
+                    # and travel compressed at the gas boundary (step())
+                    if self._grad_step_local is None:
+                        self._grad_step_local = self._build_grad_step_local(batch)
+                    loss, grads = self._grad_step_local(
+                        self.state.params, batch, self._next_rng(),
+                        self.state.scaler.scale)
+                    self._grads_are_local = True
+                else:
+                    if self._grad_step is None:
+                        self._grad_step = self._build_grad_step()
+                    loss, grads = self._grad_step(self.state.params, batch,
+                                                  self._next_rng(),
+                                                  self.state.scaler.scale)
+                    self._grads_are_local = False
+                self._cached_grads = grads
+                self._cached_loss = loss
             else:
-                if self._grad_step is None:
-                    self._grad_step = self._build_grad_step()
-                loss, grads = self._grad_step(self.state.params, batch, self._next_rng(),
-                                              self.state.scaler.scale)
-                self._grads_are_local = False
-            self._cached_grads = grads
-            self._cached_loss = loss
-        else:
-            if self._eval_step is None:
-                self._eval_step = self._build_eval_step()
-            loss = self._eval_step(self.state.params, batch, self._next_rng())
-            self._cached_loss = loss
+                if self._eval_step is None:
+                    self._eval_step = self._build_eval_step()
+                loss = self._eval_step(self.state.params, batch, self._next_rng())
+                self._cached_loss = loss
 
         self.timers(FORWARD_MICRO_TIMER).stop(sync=False)
         return loss
@@ -1056,19 +1092,22 @@ class DeepSpeedEngine:
         assert self._in_training_mode, "backward called in eval mode"
         assert self._cached_grads is not None, "backward() must follow forward()"
         self.timers(BACKWARD_MICRO_TIMER).start(sync=False)
-        if self.state.grad_acc is None:
-            # grads are already fp32 and placed by the grad_step out_shardings
-            self.state.grad_acc = self._cached_grads
-        elif getattr(self, "_grads_are_local", False):
-            if self._acc_step_local is None:
-                self._acc_step_local = jax.jit(
-                    lambda a, g: jax.tree.map(jnp.add, a, g), donate_argnums=(0,))
-            self.state.grad_acc = self._acc_step_local(self.state.grad_acc,
-                                                       self._cached_grads)
-        else:
-            if self._acc_step is None:
-                self._acc_step = self._build_acc_step()
-            self.state.grad_acc = self._acc_step(self.state.grad_acc, self._cached_grads)
+        with self._span("bwd", micro_step=self.micro_steps):
+            if self.state.grad_acc is None:
+                # grads are already fp32, placed by the grad_step out_shardings
+                self.state.grad_acc = self._cached_grads
+            elif getattr(self, "_grads_are_local", False):
+                if self._acc_step_local is None:
+                    self._acc_step_local = jax.jit(
+                        lambda a, g: jax.tree.map(jnp.add, a, g),
+                        donate_argnums=(0,))
+                self.state.grad_acc = self._acc_step_local(self.state.grad_acc,
+                                                           self._cached_grads)
+            else:
+                if self._acc_step is None:
+                    self._acc_step = self._build_acc_step()
+                self.state.grad_acc = self._acc_step(self.state.grad_acc,
+                                                     self._cached_grads)
         self._cached_grads = None
         self.micro_steps += 1
         self.timers(BACKWARD_MICRO_TIMER).stop(sync=False)
@@ -1135,10 +1174,13 @@ class DeepSpeedEngine:
                 if self._apply_step is None:
                     self._apply_step = self._build_apply_step()
                 apply = self._apply_step
-            (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped,
-             stats) = apply(self.state.params, self._opt_state_view(),
-                            self.state.grad_acc, self.state.scaler,
-                            self.state.skipped)
+            with self._span("step", step=self.global_steps,
+                            onebit=momentum_mode):
+                (self.state.params, self.state.opt_state, self.state.scaler,
+                 self.state.skipped, stats) = apply(
+                     self.state.params, self._opt_state_view(),
+                     self.state.grad_acc, self.state.scaler,
+                     self.state.skipped)
             self.state.grad_acc = None
             if self.optimizer_swapper is not None:
                 # stream the updated state back to NVMe; device copy released
@@ -1146,6 +1188,10 @@ class DeepSpeedEngine:
                 self.state.opt_state = None
             self._step_stats = stats
             self._advance_step_counters(stats)
+            if self.watchdog is not None:
+                # between optimizer steps the host legitimately blocks in
+                # user code (data loading) — don't count that as a stall
+                self.watchdog.disarm()
         self.timers(STEP_MICRO_TIMER).stop(sync=False)
 
     def _advance_step_counters(self, stats):
@@ -1185,6 +1231,20 @@ class DeepSpeedEngine:
                 if self.global_steps == fc.profile_step:
                     self.flops_profiler.print_model_profile(
                         profile_step=fc.profile_step, output_file=fc.output_file)
+                if (self.telemetry is not None
+                        and not self._flops_breakdown_emitted
+                        and self.global_steps >= fc.profile_step):
+                    # one-shot cost table so span timelines carry FLOPs
+                    # attribution (see tools/trace_merge.py --flops)
+                    try:
+                        self.telemetry.emit(
+                            "flops_breakdown",
+                            self.flops_profiler.breakdown_payload(
+                                top_modules=max(fc.top_modules, 20)),
+                            step=self.global_steps)
+                        self._flops_breakdown_emitted = True
+                    except Exception as e:
+                        logger.warning(f"flops breakdown emission failed: {e}")
             if self.telemetry is not None:
                 # values stay device arrays here; the hub drains them (one
                 # sync) at the flush boundary, never per step
@@ -1247,14 +1307,22 @@ class DeepSpeedEngine:
                                               num_micro_steps=self.gradient_accumulation_steps())
         if self.profiler_window is not None:
             self.profiler_window.step_begin(self.global_steps)
+        if self.watchdog is not None:
+            self.watchdog.arm(f"train_batch step={self.global_steps}")
         self.tput_timer.start()
-        carry = (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped)
-        carry, loss, stats = self._fused_step(carry, batch, self._next_rng())
-        (self.state.params, self.state.opt_state, self.state.scaler, self.state.skipped) = carry
+        with self._span("train_batch", step=self.global_steps,
+                        gas=self.gradient_accumulation_steps()):
+            carry = (self.state.params, self.state.opt_state, self.state.scaler,
+                     self.state.skipped)
+            carry, loss, stats = self._fused_step(carry, batch, self._next_rng())
+            (self.state.params, self.state.opt_state, self.state.scaler,
+             self.state.skipped) = carry
         self._step_stats = stats
         self._cached_loss = loss
         self.micro_steps += self.gradient_accumulation_steps()
         self._advance_step_counters(stats)
+        if self.watchdog is not None:
+            self.watchdog.disarm()
         self.tput_timer.stop(global_step=True)
         return loss
 
@@ -1327,6 +1395,13 @@ class DeepSpeedEngine:
     def monitor_enabled(self):
         return self._config.monitor_enabled
 
+    def _span(self, name, **args):
+        """Tracer span, or inert context when tracing is off (the hot path
+        then takes no tracing branch at all)."""
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, **args)
+
     def telemetry_flush(self):
         """Drain buffered telemetry records to all sinks now (one device
         sync).  No-op when telemetry is disabled."""
@@ -1335,7 +1410,8 @@ class DeepSpeedEngine:
 
     def telemetry_close(self):
         """End-of-run hook: stop any in-flight profiler trace, emit the
-        comms summary, and flush + close every sink.  Idempotent."""
+        comms summary, flush + close every sink, stop the watchdog, and
+        export this rank's span timeline.  Idempotent."""
         if self.profiler_window is not None:
             self.profiler_window.close()
         if self.telemetry is not None:
@@ -1347,6 +1423,21 @@ class DeepSpeedEngine:
                 except Exception as e:
                     logger.warning(f"comms summary emission failed: {e}")
             self.telemetry.close()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.tracer is not None:
+            from deepspeed_tpu.telemetry import (get_global_tracer,
+                                                 set_global_tracer)
+            tdir = self._config.telemetry_config.trace_dir
+            if tdir:
+                try:
+                    self.tracer.export_chrome_trace(os.path.join(
+                        tdir, f"trace_rank{self.tracer.rank}.json"))
+                except Exception as e:
+                    logger.warning(f"chrome-trace export failed: {e}")
+            if get_global_tracer() is self.tracer:
+                set_global_tracer(None)
+            self.tracer.close()
 
     def _report_progress(self):
         spp = self._config.steps_per_print

@@ -727,14 +727,20 @@ class PipelineEngine(DeepSpeedEngine):
         batch = self._place_micro_batches(batch)
         self.tput_timer.start()
         self._inside_train_batch = True
+        span_t0 = self.tracer._clock() if self.tracer is not None else 0
         try:
-            # the whole M-deep pipeline is one "forward" program
-            loss = self.forward(batch)
-            self.backward(loss)
-            self.micro_steps += gas - 1  # forward/backward consumed all gas micros
-            self.step()
+            with self._span("pipe.train_batch", step=self.global_steps,
+                            schedule=self.schedule, stages=self._adapted.P,
+                            micro_batches=gas):
+                # the whole M-deep pipeline is one "forward" program
+                loss = self.forward(batch)
+                self.backward(loss)
+                self.micro_steps += gas - 1  # fwd/bwd consumed all gas micros
+                self.step()
         finally:
             self._inside_train_batch = False
+        if self.tracer is not None:
+            self._emit_schedule_slots(span_t0, self.tracer._clock(), gas)
         self.tput_timer.stop(global_step=True)
         if self.telemetry is not None:
             self.telemetry.emit("pipe", {
@@ -744,6 +750,43 @@ class PipelineEngine(DeepSpeedEngine):
                 "bubble_fraction": self.bubble_fraction(gas),
             }, step=self.global_steps)
         return loss
+
+    # cap on synthetic slots per train_batch (gas × stages × 2 can explode
+    # on deep pipelines; past this the timeline stops being readable anyway)
+    _MAX_SCHEDULE_SLOTS = 4096
+
+    def _emit_schedule_slots(self, t0_ns, t1_ns, gas):
+        """Per-microbatch schedule-slot spans on synthetic per-stage tracks.
+
+        The pipelined step is ONE fused XLA program, so real per-slot host
+        timestamps do not exist; instead the analytic schedule (the same
+        model ``bubble_fraction`` uses) is laid over the measured host
+        window — gpipe: micro ``m`` runs forward on stage ``s`` at tick
+        ``s + m`` of ``M + P - 1``; 1f1b adds backward slots at tick
+        ``m + 2P - 1 - s`` of ``M + 2P - 1``.  Every slot is tagged
+        ``synthetic`` so nobody mistakes it for a measurement."""
+        M, P = gas, self._adapted.P
+        one_f1b = self.schedule == "1f1b"
+        ticks = M + (2 * P - 1 if one_f1b else P - 1)
+        n_slots = M * P * (2 if one_f1b else 1)
+        if n_slots > self._MAX_SCHEDULE_SLOTS or ticks <= 0 or t1_ns <= t0_ns:
+            return
+        tick_ns = (t1_ns - t0_ns) / ticks
+        at = lambda t: int(t0_ns + t * tick_ns)
+        for s in range(P):
+            track = f"pipe.stage{s}"
+            for m in range(M):
+                tf = s + m
+                self.tracer.add_span(
+                    f"pipe.fwd.m{m}", at(tf), at(tf + 1), track=track,
+                    micro=m, stage=s, tick=tf, schedule=self.schedule,
+                    step=self.global_steps, synthetic=True)
+                if one_f1b:
+                    tb = m + 2 * P - 1 - s
+                    self.tracer.add_span(
+                        f"pipe.bwd.m{m}", at(tb), at(tb + 1), track=track,
+                        micro=m, stage=s, tick=tb, schedule=self.schedule,
+                        step=self.global_steps, synthetic=True)
 
     def eval_batch(self, batch):
         batch = self._place_micro_batches(batch)

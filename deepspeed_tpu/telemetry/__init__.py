@@ -1,14 +1,18 @@
 """deepspeed_tpu.telemetry — structured step events, JSONL sink, windowed
-XLA profiler capture.  See README.md § Telemetry for config keys and the
-JSONL schema."""
+XLA profiler capture, span tracing, and the hang-watchdog flight recorder.
+See README.md § Telemetry / § Tracing for config keys and schemas."""
 
 from deepspeed_tpu.telemetry import events
 from deepspeed_tpu.telemetry.events import (SCHEMA_VERSION,
                                             STEP_REQUIRED_FIELDS, make_record)
+from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder, read_dump
 from deepspeed_tpu.telemetry.hub import (JsonlSink, MonitorSink,
                                          RingBufferSink, TelemetryHub,
                                          TelemetrySink)
 from deepspeed_tpu.telemetry.profiler import ProfilerWindow
+from deepspeed_tpu.telemetry.tracing import (Tracer, get_global_tracer,
+                                             maybe_span, set_global_tracer)
+from deepspeed_tpu.telemetry.watchdog import HangWatchdog
 
 __all__ = [
     "events",
@@ -21,4 +25,11 @@ __all__ = [
     "RingBufferSink",
     "MonitorSink",
     "ProfilerWindow",
+    "Tracer",
+    "set_global_tracer",
+    "get_global_tracer",
+    "maybe_span",
+    "HangWatchdog",
+    "FlightRecorder",
+    "read_dump",
 ]

@@ -24,9 +24,12 @@ PIPE = "pipe"                      # pipeline schedule stats (bubble fraction)
 INFERENCE = "inference_request"    # one generate()/forward() serving request
 MOE = "moe_gauge"                  # expert-load / drop-fraction gauges
 COMM_SUMMARY = "comm_summary"      # CommsLogger fold (op counts/bytes/bw)
+FLOPS_BREAKDOWN = "flops_breakdown"  # one-shot per-module FLOPs cost table
+WORKER_EXIT = "worker_exit"        # elastic-agent worker group exit/restart
 SCHEMA = "schema"                  # JSONL header record (written by the sink)
 
-KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, SCHEMA)
+KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
+         WORKER_EXIT, SCHEMA)
 
 # Every `step` record carries at least these keys once drained.
 STEP_REQUIRED_FIELDS = (

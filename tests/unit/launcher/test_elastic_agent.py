@@ -83,3 +83,77 @@ class TestElasticAgent:
             monitor_interval=0.1)
         agent.run()
         assert out.read_text() == "42"
+
+
+class TestWorkerExitTelemetry:
+
+    def _hub(self):
+        from deepspeed_tpu.telemetry import RingBufferSink, TelemetryHub
+        ring = RingBufferSink(capacity=64)
+        hub = TelemetryHub(sinks=[ring], flush_every=0,
+                           sync_fn=lambda: None,
+                           memory_stats_fn=lambda: {})
+        return hub, ring
+
+    def test_clean_exit_emits_worker_exit(self, tmp_path):
+        hub, ring = self._hub()
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, "print('ok')\n")),
+                               monitor_interval=0.1, telemetry=hub)
+        assert agent.run() == 0
+        recs = ring.of_kind("worker_exit")
+        assert len(recs) == 1
+        assert recs[0]["exit_code"] == 0
+        assert recs[0]["reason"] == "clean_exit"
+        assert recs[0]["restart_count"] == 0
+
+    def test_failures_and_give_up_are_audited(self, tmp_path):
+        hub, ring = self._hub()
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, "import sys; sys.exit(5)\n")),
+            max_restarts=2, monitor_interval=0.1, telemetry=hub)
+        assert agent.run() == 5
+        reasons = [r["reason"] for r in ring.of_kind("worker_exit")]
+        assert reasons == ["worker_failure", "worker_failure",
+                           "max_restarts_exceeded"]
+        assert all(r["exit_code"] == 5 for r in ring.of_kind("worker_exit"))
+
+    def test_stop_reaps_whole_process_group(self, tmp_path):
+        """The worker forks a child into the same process group; after
+        _stop() neither the leader nor the grandchild may survive."""
+        pid_file = tmp_path / "pids"
+        body = (
+            "import os, sys, time, subprocess\n"
+            "child = subprocess.Popen(\n"
+            "    [sys.executable, '-c', 'import time; time.sleep(60)'])\n"
+            f"open({str(pid_file)!r}, 'w').write(\n"
+            "    f'{os.getpid()} {child.pid}')\n"
+            "time.sleep(60)\n")
+        hub, ring = self._hub()
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, body)),
+                               monitor_interval=0.1, telemetry=hub)
+        agent._start(1)
+        for _ in range(100):
+            if pid_file.exists() and len(pid_file.read_text().split()) == 2:
+                break
+            time.sleep(0.1)
+        leader, grandchild = map(int, pid_file.read_text().split())
+        rc = agent._stop(reason="test_stop")
+        assert rc is not None and rc != 0
+        # process group is gone: each pid is either fully reaped or at
+        # most a zombie awaiting its (reparented) init — never running
+        def dead(pid):
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    return f.read().split(")")[-1].split()[0] == "Z"
+            except OSError:
+                return True
+
+        for pid in (leader, grandchild):
+            for _ in range(50):
+                if dead(pid):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail(f"pid {pid} survived _stop()")
+        recs = ring.of_kind("worker_exit")
+        assert recs and recs[-1]["reason"] == "test_stop"

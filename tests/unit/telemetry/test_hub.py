@@ -96,6 +96,27 @@ class TestMonitorFanout:
         assert rows[2].split(",") == ["2", "0.5"]
         assert (tmp_path / "job" / "Train_Telemetry_samples_per_sec.csv").exists()
 
+    def test_csv_monitor_recreates_deleted_output_dir(self, tmp_path):
+        import shutil
+        cfg = SimpleNamespace(output_path=str(tmp_path), job_name="job",
+                              monitor_config=None)
+        csv_writer = csvMonitor(cfg)
+        shutil.rmtree(tmp_path / "job")   # tmp cleaner raced the run
+        csv_writer.write_events([("Train/loss", 0.5, 1)])
+        assert (tmp_path / "job" / "Train_loss.csv").exists()
+
+    def test_csv_monitor_escapes_commas_and_newlines_in_tags(self, tmp_path):
+        cfg = SimpleNamespace(output_path=str(tmp_path), job_name="job",
+                              monitor_config=None)
+        csv_writer = csvMonitor(cfg)
+        csv_writer.write_events([("Train/loss,clipped\nraw", 0.25, 7)])
+        files = [p.name for p in (tmp_path / "job").iterdir()]
+        assert files == ["Train_loss_clipped_raw.csv"]
+        rows = (tmp_path / "job" / files[0]).read_text().strip().splitlines()
+        # the sanitized tag keeps the header to exactly two columns
+        assert rows[0] == "step,Train_loss_clipped_raw"
+        assert rows[1] == "7,0.25"
+
 
 class TestJsonlSink:
 

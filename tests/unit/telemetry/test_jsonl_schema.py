@@ -78,8 +78,34 @@ class TestJsonlAcceptance:
             assert rec["lr"] == 1e-2
         # losses resolve to real host floats and the toy model learns
         assert steps[-1]["loss"] < steps[0]["loss"] * 2  # sane magnitude
+
+    def test_traced_run_exports_chrome_trace_and_watchdog_stays_quiet(
+            self, tmp_path):
+        """Tracing + watchdog on a real CPU run: phase spans land in the
+        per-rank Chrome trace, and a healthy run never trips the stall
+        detector."""
+        cfg = train_config(telemetry={
+            "enabled": True, "jsonl_path": str(tmp_path / "run.jsonl"),
+            "flush_every": 2,
+            "tracing": True, "trace_dir": str(tmp_path / "traces"),
+            "watchdog_enabled": True, "watchdog_timeout_s": 300.0,
+            "watchdog_signal_dump": False})
+        engine = run_training(cfg, nsteps=2)
+        assert engine.tracer is not None and engine.watchdog is not None
+        assert engine.watchdog.stall_count == 0
+        engine.telemetry_close()
+
+        doc = json.loads((tmp_path / "traces" / "trace_rank0.json").read_text())
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        names = {e["name"] for e in spans}
+        assert {"fwd", "bwd", "step"} <= names
+        assert all(e["dur"] >= 0 for e in spans)
+        # merge-ready: the clock anchor trace_merge aligns on is present
+        assert {"mono_ns", "wall_ns"} <= set(doc["metadata"]["clock_sync"])
+        # watchdog poll thread is gone after close
+        assert not engine.watchdog._thread or not engine.watchdog._thread.is_alive()
         # ring buffer sink sees the same records (default ring enabled)
-        assert len(engine.telemetry.ring.of_kind(events.STEP)) == 3
+        assert len(engine.telemetry.ring.of_kind(events.STEP)) == 2
 
     def test_fused_train_batch_also_records(self, tmp_path):
         path = tmp_path / "fused.jsonl"
