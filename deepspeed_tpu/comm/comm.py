@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.telemetry.tracing import get_global_tracer
+from deepspeed_tpu.testing.fault_injection import fault_point
 from deepspeed_tpu.utils.logging import logger
 
 AxisNames = Union[str, Sequence[str]]
@@ -170,6 +171,7 @@ def _log_op(name: str, tensor, group=None):
     fuses into the XLA program, so the span marks when the collective was
     staged (and, via jax.named_scope, names it in device profiles); run
     time shows up in the profiler capture, not here."""
+    fault_point("comm.collective", op=name)
     try:
         nbytes = tensor.size * tensor.dtype.itemsize
     except Exception:

@@ -15,12 +15,15 @@ checkpoints carrying the state (SURVEY §5.3's TPU mapping).
 """
 
 import os
+import random
 import signal
 import subprocess
 import time
 from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.runtime.fault_tolerance import (PREEMPTION_EXIT_CODES,
+                                                   backoff_delay)
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -49,22 +52,52 @@ class DSElasticAgent:
     def __init__(self, spec: WorkerSpec, ds_config: Optional[Dict] = None,
                  max_restarts: int = 3, monitor_interval: float = 1.0,
                  world_size_fn: Optional[Callable[[], int]] = None,
-                 telemetry=None):
+                 telemetry=None,
+                 restart_backoff_s: float = 1.0,
+                 restart_backoff_max_s: float = 30.0,
+                 restart_jitter: float = 0.2,
+                 stability_window_s: float = 300.0,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None):
         """``world_size_fn`` reports the currently-available world size
         (pod metadata / scheduler probe); a change triggers a restart with
         a re-solved elastic batch config.  ``telemetry`` (a TelemetryHub)
         receives a structured ``worker_exit`` record for every worker-group
         exit — failure, membership change, clean finish, or give-up — so
-        restarts leave an audit trail instead of happening silently."""
+        restarts leave an audit trail instead of happening silently.
+
+        Restart hygiene: crash restarts back off exponentially
+        (``restart_backoff_s`` → ``restart_backoff_max_s``, ±``restart_jitter``
+        relative noise against stampedes), and a group that stayed up for
+        ``stability_window_s`` seconds resets the restart budget — a crash
+        every few hours must not accumulate toward give-up forever.
+        Workers exiting with the preemption code (143 / -SIGTERM) restart
+        immediately without touching the budget: the scheduler took the
+        machine, the program did nothing wrong.  The knobs are overridable
+        via the ``fault_tolerance`` block of ``ds_config``.  ``sleep_fn``
+        and ``rng`` are injectable so tests never wall-clock sleep."""
         self.spec = spec
         self.ds_config = ds_config or {}
+        ftc = self.ds_config.get("fault_tolerance", {})
         self.max_restarts = max_restarts
         self.monitor_interval = monitor_interval
         self.world_size_fn = world_size_fn or (lambda: 1)
         self.telemetry = telemetry
+        self.restart_backoff_s = float(
+            ftc.get("restart_backoff_s", restart_backoff_s))
+        self.restart_backoff_max_s = float(
+            ftc.get("restart_backoff_max_s", restart_backoff_max_s))
+        self.restart_jitter = float(ftc.get("restart_jitter", restart_jitter))
+        self.stability_window_s = float(
+            ftc.get("stability_window_s", stability_window_s))
+        self._sleep = sleep_fn
+        self._rng = rng
         self.restart_count = 0
+        self.preemption_count = 0
         self._proc: Optional[subprocess.Popen] = None
         self._world = None
+        self._start_t: Optional[float] = None
+        self._last_backoff_s = 0.0
 
     def _emit_worker_exit(self, exit_code, reason: str):
         if self.telemetry is None:
@@ -74,6 +107,10 @@ class DSElasticAgent:
                 "exit_code": exit_code,
                 "reason": reason,
                 "restart_count": self.restart_count,
+                "preemption_count": self.preemption_count,
+                "uptime_s": (time.monotonic() - self._start_t
+                             if self._start_t is not None else None),
+                "backoff_s": self._last_backoff_s,
                 "world_size": self._world,
                 "pid": self._proc.pid if self._proc is not None else None,
             })
@@ -101,6 +138,7 @@ class DSElasticAgent:
         env = self._elastic_env(world)
         self._proc = subprocess.Popen(self.spec.argv(env), env=env,
                                       start_new_session=True)
+        self._start_t = time.monotonic()
         log_dist(f"elastic agent: started workers (pid {self._proc.pid}, "
                  f"world {world})", ranks=[0])
 
@@ -164,15 +202,40 @@ class DSElasticAgent:
                     log_dist("elastic agent: workers finished", ranks=[0])
                     self._stop(reason="clean_exit")
                     return 0
+                uptime = (time.monotonic() - self._start_t
+                          if self._start_t is not None else 0.0)
+                if rc in PREEMPTION_EXIT_CODES:
+                    # the scheduler reclaimed the machine, not a bug:
+                    # restart now, leave the crash budget untouched
+                    self.preemption_count += 1
+                    self._last_backoff_s = 0.0
+                    log_dist(f"elastic agent: workers preempted (rc={rc}, "
+                             f"uptime {uptime:.1f}s) — restarting "
+                             f"immediately", ranks=[0])
+                    self._stop(reason="preemption")
+                    self._start(self.world_size_fn())
+                    continue
+                if uptime >= self.stability_window_s and self.restart_count:
+                    # the group ran long enough to call the previous
+                    # failures transient — the budget regenerates
+                    log_dist(f"elastic agent: {uptime:.0f}s of stable uptime; "
+                             f"resetting restart budget", ranks=[0])
+                    self.restart_count = 0
                 if self.restart_count >= self.max_restarts:
                     logger.error(f"elastic agent: giving up after "
                                  f"{self.restart_count} restarts (rc={rc})")
                     self._stop(reason="max_restarts_exceeded")
                     return rc
                 self.restart_count += 1
+                self._last_backoff_s = backoff_delay(
+                    self.restart_count, self.restart_backoff_s,
+                    self.restart_backoff_max_s, self.restart_jitter,
+                    rng=self._rng)
                 log_dist(f"elastic agent: worker failure rc={rc} — restart "
-                         f"{self.restart_count}/{self.max_restarts}", ranks=[0])
+                         f"{self.restart_count}/{self.max_restarts} in "
+                         f"{self._last_backoff_s:.2f}s", ranks=[0])
                 self._stop(reason="worker_failure")
+                self._sleep(self._last_backoff_s)
                 self._start(self.world_size_fn())
                 continue
             world = self.world_size_fn()

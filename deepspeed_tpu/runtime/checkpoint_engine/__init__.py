@@ -1,6 +1,12 @@
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
     CheckpointEngine, LocalCheckpointEngine, OrbaxCheckpointEngine,
     get_checkpoint_engine)
+from deepspeed_tpu.runtime.checkpoint_engine.manifest import (MANIFEST_FILE,
+                                                              manifest_ok,
+                                                              verify_manifest,
+                                                              write_manifest)
 
 __all__ = ["CheckpointEngine", "OrbaxCheckpointEngine",
-           "LocalCheckpointEngine", "get_checkpoint_engine"]
+           "LocalCheckpointEngine", "get_checkpoint_engine",
+           "MANIFEST_FILE", "write_manifest", "verify_manifest",
+           "manifest_ok"]

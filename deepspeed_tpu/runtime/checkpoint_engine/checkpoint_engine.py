@@ -153,4 +153,16 @@ def get_checkpoint_engine(name: str = "orbax", async_save: bool = False,
         return OrbaxCheckpointEngine(config_params, async_save=async_save)
     if name == "local":
         return LocalCheckpointEngine(config_params)
+    if name == "faulty":
+        # test-only storage backend: a real engine wrapped with scripted
+        # fault sites (deepspeed_tpu/testing/fault_injection.py).
+        # config_params: {"inner": "local"|"orbax", "plan": [rules...]}
+        from deepspeed_tpu.testing.fault_injection import (FaultInjector,
+                                                           FaultyCheckpointEngine)
+        cp = dict(config_params or {})
+        inner = get_checkpoint_engine(cp.get("inner", "local"),
+                                      async_save=async_save)
+        plan = cp.get("plan")
+        return FaultyCheckpointEngine(
+            inner, injector=FaultInjector(plan) if plan is not None else None)
     raise ValueError(f"unknown checkpoint engine {name!r}")

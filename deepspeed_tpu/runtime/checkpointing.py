@@ -1,4 +1,4 @@
-"""Engine checkpoint save/load.
+"""Engine checkpoint save/load with verified-atomic durability.
 
 Reference: ``engine.save_checkpoint`` (``engine.py:2816``) writes per-rank
 ``mp_rank_XX_model_states.pt`` + ``*_optim_states.pt`` files plus a
@@ -9,27 +9,62 @@ TPU-native: one Orbax/tensorstore checkpoint per tag holding the sharded
 params + optimizer state with sharding metadata, so loading under a
 *different* mesh (dp resize, stage change) is reshard-on-restore — the
 capability the reference implements with its ``deepspeed/checkpoint``
-reshaping tools falls out of the storage format here.  Layout:
+reshaping tools falls out of the storage format here.
+
+Fault tolerance (``fault_tolerance`` config block):
+
+* **Atomic saves** — state is staged into a hidden ``.tmp.<tag>`` sibling,
+  checksummed into a ``MANIFEST.json`` after the commit barrier, fsynced,
+  and renamed into place; only then does the ``latest`` pointer move
+  (itself an fsync + ``os.replace``).  A crash at ANY point leaves either
+  the previous durable checkpoint or the new one — never torn bytes
+  behind a live pointer.
+* **Retries** — transient ``OSError``\\ s during save/commit back off
+  exponentially (telemetry ``ckpt_retry``) before giving up with
+  :class:`~deepspeed_tpu.runtime.fault_tolerance.CheckpointWriteError`.
+* **Rollback on load** — a corrupt/torn/missing newest tag walks back
+  through prior verified tags (telemetry ``ckpt_rollback``) instead of
+  dying with a restore traceback.
+* **Retention** — ``keep_last_n`` old tags are garbage-collected after
+  each successful commit.
+
+Crash-critical boundaries carry ``fault_point`` sites (``ckpt.pre_save``,
+``ckpt.mid_save``, ``ckpt.pre_commit``, ``ckpt.post_commit``) so the
+recovery matrix is exercised by deterministic CPU tests
+(``deepspeed_tpu/testing/fault_injection.py``).
+
+Layout::
 
     save_dir/
       latest                      <- text file with the newest tag
       <tag>/
+        MANIFEST.json             <- per-file size+crc32, written post-commit
         state/                    <- orbax pytree (params, opt, scaler, counters)
         client_state.json         <- user client_state + engine counters
 """
 
 import json
 import os
-from typing import Any, Dict, Optional
+import re
+import shutil
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.runtime.checkpoint_engine.manifest import (
+    atomic_write_json, atomic_write_text, fsync_dir, manifest_ok,
+    write_manifest)
+from deepspeed_tpu.runtime.fault_tolerance import (CheckpointCorruptError,
+                                                   CheckpointWriteError,
+                                                   retry_transient)
 from deepspeed_tpu.telemetry.tracing import maybe_span
+from deepspeed_tpu.testing.fault_injection import fault_point
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
+STAGING_PREFIX = ".tmp."
 
 
 def _ckpt_engine(engine):
@@ -57,18 +92,100 @@ def _engine_tree(engine) -> Dict[str, Any]:
     }
 
 
+def _ft_cfg(engine):
+    cfg = getattr(getattr(engine, "_config", None), "fault_tolerance_config",
+                  None)
+    if cfg is None:
+        from deepspeed_tpu.runtime.config import DeepSpeedFaultToleranceConfig
+        cfg = DeepSpeedFaultToleranceConfig()
+    return cfg
+
+
+def _emit(engine, kind: str, payload: Dict[str, Any], flush: bool = False):
+    """Telemetry emission that never turns a checkpoint op into a crash."""
+    hub = getattr(engine, "telemetry", None)
+    if hub is None:
+        return
+    try:
+        hub.emit(kind, payload, step=getattr(engine, "global_steps", None))
+        if flush:
+            hub.flush()
+    except Exception as e:
+        logger.warning(f"checkpoint telemetry emission failed: {e}")
+
+
+def _retry(engine, ft, what: str, fn):
+    """Retry a storage op per the fault_tolerance config, surfacing a
+    CheckpointWriteError once the budget is spent."""
+
+    def on_retry(attempt, delay, exc):
+        logger.warning(f"checkpoint {what} failed ({exc}); retry "
+                       f"{attempt}/{ft.save_retries} in {delay:.2f}s")
+        _emit(engine, "ckpt_retry", {"what": what, "attempt": attempt,
+                                     "delay_s": delay, "error": str(exc)})
+
+    try:
+        return retry_transient(fn, retries=ft.save_retries,
+                               base_s=ft.retry_backoff_s,
+                               max_s=ft.retry_backoff_max_s,
+                               on_retry=on_retry)
+    except OSError as e:
+        raise CheckpointWriteError(
+            f"checkpoint {what} failed after {ft.save_retries} retries: {e}"
+        ) from e
+
+
+# --------------------------------------------------------------------------- #
+# Async-finalizer hygiene
+# --------------------------------------------------------------------------- #
+def wait_for_finalizer(engine, timeout: Optional[float] = None,
+                       raise_on_error: bool = True):
+    """Join the async-save finalizer thread and surface its failure.
+
+    The finalizer owns the durability barrier + pointer move; losing its
+    exception in a daemon thread would let training run forever on the
+    belief a checkpoint exists.  Every save/load joins here first, and
+    ``engine.close()`` joins on shutdown (logging instead of raising)."""
+    fin = getattr(engine, "_ckpt_finalizer", None)
+    if fin is not None and fin.is_alive():
+        fin.join(timeout)
+        if fin.is_alive():
+            logger.warning(f"checkpoint finalizer still running after "
+                           f"{timeout}s join timeout")
+    err = getattr(engine, "_ckpt_finalizer_error", None)
+    if err is not None:
+        engine._ckpt_finalizer_error = None
+        if raise_on_error:
+            raise CheckpointWriteError(
+                f"previous async checkpoint finalize failed: {err}") from err
+        logger.error(f"async checkpoint finalize failed: {err}")
+
+
+# --------------------------------------------------------------------------- #
+# Save
+# --------------------------------------------------------------------------- #
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict] = None, save_latest: bool = True):
-    tag = tag if tag is not None else f"global_step{engine.global_steps}"
-    tag = str(tag)
-    ckpt_dir = os.path.join(save_dir, tag)
+    wait_for_finalizer(engine)
+    ft = _ft_cfg(engine)
+    tag = str(tag if tag is not None else f"global_step{engine.global_steps}")
+    final_dir = os.path.join(save_dir, tag)
     os.makedirs(save_dir, exist_ok=True)
+    engine._last_ckpt_dir = save_dir
+
+    atomic = bool(getattr(ft, "atomic_save", True))
+    work_dir = os.path.join(save_dir, STAGING_PREFIX + tag) if atomic else final_dir
+    if atomic and os.path.isdir(work_dir):
+        shutil.rmtree(work_dir)          # stale staging from a crashed save
 
     ce = _ckpt_engine(engine)
     with maybe_span("checkpoint.save", tag=tag, dir=save_dir):
         ce.create(tag)
-        state_path = os.path.join(ckpt_dir, "state")
-        ce.save(_engine_tree(engine), state_path)
+        state_path = os.path.join(work_dir, "state")
+        fault_point("ckpt.pre_save", tag=tag, path=work_dir)
+        tree = _engine_tree(engine)
+        _retry(engine, ft, "save", lambda: ce.save(tree, state_path))
+        fault_point("ckpt.mid_save", tag=tag, path=work_dir)
 
     meta = {
         "global_steps": engine.global_steps,
@@ -83,13 +200,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "mesh_shape": {k: int(v) for k, v in engine.mesh.shape.items()},
     }
     if jax.process_index() == 0:
-        with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
-            json.dump(meta, f)
+        atomic_write_json(os.path.join(work_dir, "client_state.json"), meta)
         # recovery script rides along with every checkpoint (reference
         # engine.py:3125 copies utils/zero_to_fp32.py into the ckpt dir)
         try:
-            import shutil
-
             from deepspeed_tpu.utils import zero_to_fp32 as _z2f
             shutil.copyfile(_z2f.__file__,
                             os.path.join(save_dir, "zero_to_fp32.py"))
@@ -97,23 +211,40 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             logger.warning(f"could not copy zero_to_fp32.py: {e}")
 
     def _finalize():
-        # commit is the durability barrier; only a durable checkpoint may
-        # become 'latest' — a crash mid-stream must not leave the pointer
-        # aimed at torn bytes
-        ce.commit(tag)
-        if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
-        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+        # commit is the durability barrier; only a durable, verified
+        # checkpoint may become 'latest' — a crash mid-stream must not
+        # leave the pointer aimed at torn bytes
+        fault_point("ckpt.pre_commit", tag=tag, path=work_dir)
+        _retry(engine, ft, "commit", lambda: ce.commit(tag))
+        if jax.process_index() == 0:
+            if atomic:
+                write_manifest(work_dir, extra={
+                    "tag": tag, "global_steps": engine.global_steps,
+                    "engine": type(ce).__name__})
+                _promote(work_dir, final_dir)
+            if save_latest:
+                atomic_write_text(os.path.join(save_dir, LATEST_FILE), tag)
+            fault_point("ckpt.post_commit", tag=tag, path=final_dir)
+            _gc_old_tags(save_dir, keep_last_n=int(getattr(ft, "keep_last_n", 0)),
+                         protect={tag})
+        _emit(engine, "ckpt_saved", {"tag": tag, "dir": save_dir,
+                                     "atomic": atomic})
+        log_dist(f"saved checkpoint {final_dir}", ranks=[0])
+
+    def _finalize_guarded():
+        try:
+            _finalize()
+        except BaseException as e:       # surfaced at the next join point
+            engine._ckpt_finalizer_error = e
+            logger.error(f"async checkpoint finalize for tag {tag} "
+                         f"failed: {e}")
 
     if getattr(ce, "async_save", False):
         # async engine: training resumes now; durability + pointer move
-        # complete in the background (joined by the next load/save/wait)
+        # complete in the background (joined by the next load/save/close)
         import threading
-        prev = getattr(engine, "_ckpt_finalizer", None)
-        if prev is not None and prev.is_alive():
-            prev.join()
-        t = threading.Thread(target=_finalize, daemon=True)
+        t = threading.Thread(target=_finalize_guarded, daemon=True,
+                             name=f"ckpt-finalize-{tag}")
         t.start()
         engine._ckpt_finalizer = t
     else:
@@ -121,24 +252,183 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     return True
 
 
+def _promote(work_dir: str, final_dir: str):
+    """Atomically swap the staged checkpoint into its final name.  An
+    existing tag dir (re-save of the same tag) is parked under a hidden
+    name first so there is never a moment with a half-deleted visible
+    tag; leftovers of either kind are swept by the GC."""
+    parent = os.path.dirname(final_dir)
+    trash = None
+    if os.path.isdir(final_dir):
+        trash = os.path.join(parent, ".old." + os.path.basename(final_dir))
+        if os.path.isdir(trash):
+            shutil.rmtree(trash, ignore_errors=True)
+        os.rename(final_dir, trash)
+    os.rename(work_dir, final_dir)
+    fsync_dir(parent)
+    if trash is not None:
+        shutil.rmtree(trash, ignore_errors=True)
+
+
+def _natural_key(s: str):
+    return [int(p) if p.isdigit() else p for p in re.split(r"(\d+)", s)]
+
+
+def _list_tags(save_dir: str) -> List[str]:
+    """Visible tag dirs, newest first (natural sort: global_step10 beats
+    global_step9)."""
+    try:
+        names = os.listdir(save_dir)
+    except OSError:
+        return []
+    tags = []
+    for name in names:
+        if name.startswith("."):
+            continue
+        d = os.path.join(save_dir, name)
+        if not os.path.isdir(d):
+            continue
+        if (os.path.exists(os.path.join(d, "client_state.json"))
+                or os.path.exists(os.path.join(d, "MANIFEST.json"))
+                or os.path.exists(os.path.join(d, "state"))
+                or os.path.exists(os.path.join(d, "state.npz"))):
+            tags.append(name)
+    return sorted(tags, key=_natural_key, reverse=True)
+
+
+def _gc_old_tags(save_dir: str, keep_last_n: int, protect: set):
+    """Retention window: drop tags beyond the newest ``keep_last_n`` (0 =
+    keep everything) plus whatever ``latest`` points at, and sweep stale
+    hidden staging/park dirs left by crashed saves."""
+    latest_path = os.path.join(save_dir, LATEST_FILE)
+    if os.path.isfile(latest_path):
+        try:
+            with open(latest_path) as f:
+                protect = protect | {f.read().strip()}
+        except OSError:
+            pass
+    try:
+        for name in os.listdir(save_dir):
+            if name.startswith(STAGING_PREFIX) or name.startswith(".old."):
+                if name[len(STAGING_PREFIX):] not in protect \
+                        and name[len(".old."):] not in protect:
+                    shutil.rmtree(os.path.join(save_dir, name),
+                                  ignore_errors=True)
+    except OSError:
+        pass
+    if keep_last_n <= 0:
+        return
+    tags = _list_tags(save_dir)
+    for tag in tags[keep_last_n:]:
+        if tag in protect:
+            continue
+        shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+        log_dist(f"checkpoint retention: dropped old tag {tag}", ranks=[0])
+
+
+# --------------------------------------------------------------------------- #
+# Load (+ verification and rollback)
+# --------------------------------------------------------------------------- #
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
                     load_module_only: bool = False):
-    fin = getattr(engine, "_ckpt_finalizer", None)
-    if fin is not None and fin.is_alive():
-        fin.join()
-    if tag is None:
+    wait_for_finalizer(engine)
+    ft = _ft_cfg(engine)
+    explicit = tag is not None
+    if not explicit:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.isfile(latest):
             logger.warning(f"no 'latest' file at {latest}; nothing loaded")
             return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
+        try:
+            with open(latest) as f:
+                tag = f.read().strip()
+        except OSError as e:
+            logger.warning(f"unreadable 'latest' file at {latest} ({e}); "
+                           f"scanning for tags")
+            tag = ""
+
+    rollback_ok = (not explicit) and bool(getattr(ft, "rollback", True))
+    candidates = _candidate_tags(load_dir, tag, ft) if rollback_ok \
+        else [str(tag)]
+    failures: List[Dict[str, Any]] = []
+
+    for cand in candidates:
+        ok, report = _verify_tag(engine, load_dir, cand, ft)
+        if not ok:
+            status = report.get("status", "corrupt")
+            if status == "missing" and not failures and not rollback_ok:
+                # legacy behavior: an absent checkpoint is a no-op load
+                logger.warning(f"checkpoint {os.path.join(load_dir, str(cand))} "
+                               f"not found")
+                return None, {}
+            failures.append({"tag": cand, "status": status,
+                             "errors": report.get("errors", [])})
+            logger.error(f"checkpoint tag {cand!r} failed verification "
+                         f"({status}): {report.get('errors', [])[:3]}")
+            if not rollback_ok:
+                raise CheckpointCorruptError(
+                    f"checkpoint {os.path.join(load_dir, str(cand))} is "
+                    f"{status} and rollback is disabled (explicit tag or "
+                    f"fault_tolerance.rollback=false): {report.get('errors')}")
+            continue
+        try:
+            result = _load_tag(engine, load_dir, cand,
+                               load_optimizer_states=load_optimizer_states,
+                               load_lr_scheduler_states=load_lr_scheduler_states,
+                               load_module_only=load_module_only)
+        except Exception as e:
+            if not rollback_ok:
+                raise
+            failures.append({"tag": cand, "status": "load_error",
+                             "errors": [str(e)]})
+            logger.error(f"restore of tag {cand!r} failed ({e}); "
+                         f"rolling back")
+            continue
+        if failures:
+            _emit(engine, "ckpt_rollback",
+                  {"dir": load_dir, "from_tag": candidates[0],
+                   "to_tag": cand, "failures": failures}, flush=True)
+            logger.warning(f"rolled back from {candidates[0]!r} to last "
+                           f"verified checkpoint {cand!r}")
+        return result
+
+    if failures:
+        _emit(engine, "ckpt_rollback",
+              {"dir": load_dir, "from_tag": candidates[0] if candidates else None,
+               "to_tag": None, "failures": failures}, flush=True)
+    logger.warning(f"no verified checkpoint under {load_dir}; nothing loaded")
+    return None, {}
+
+
+def _candidate_tags(load_dir: str, requested: str, ft) -> List[str]:
+    """The requested tag first, then prior tags newest-first, capped at
+    1 + ``max_rollback``."""
+    out = [requested] if requested else []
+    for t in _list_tags(load_dir):
+        if t not in out:
+            out.append(t)
+    cap = 1 + max(0, int(getattr(ft, "max_rollback", 3)))
+    return out[:cap]
+
+
+def _verify_tag(engine, load_dir: str, tag: str, ft):
+    if not tag:
+        return False, {"status": "missing"}
     ckpt_dir = os.path.join(load_dir, str(tag))
     state_path = os.path.join(ckpt_dir, "state")
-    if not _ckpt_engine(engine).exists(state_path):
-        logger.warning(f"checkpoint {ckpt_dir} not found")
-        return None, {}
+    if not os.path.isdir(ckpt_dir) or not _ckpt_engine(engine).exists(state_path):
+        return False, {"status": "missing", "dir": ckpt_dir}
+    if not getattr(ft, "verify_on_load", True):
+        return True, {"status": "unverified", "dir": ckpt_dir}
+    return manifest_ok(ckpt_dir)
+
+
+def _load_tag(engine, load_dir: str, tag: str,
+              load_optimizer_states: bool, load_lr_scheduler_states: bool,
+              load_module_only: bool):
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    state_path = os.path.join(ckpt_dir, "state")
 
     # Restore with the *current* engine shardings — a different mesh/stage
     # than at save time reshards on read (elastic checkpointing,

@@ -122,6 +122,38 @@ class DeepSpeedCheckpointConfig(DeepSpeedConfigModel):
     engine: str = "orbax"
 
 
+class DeepSpeedFaultToleranceConfig(DeepSpeedConfigModel):
+    """``fault_tolerance`` block — verified atomic checkpoints, load
+    rollback, and preemption-aware shutdown.  Atomic saves and manifest
+    verification are ON by default (they are strictly safer and cost one
+    checksum pass per commit); the preemption handler is opt-in because
+    it installs a SIGTERM handler.  See README.md § Fault tolerance.
+    """
+    # verified atomic saves (stage → commit → manifest → rename → latest)
+    atomic_save: bool = True
+    keep_last_n: int = 0            # retention window; 0 = keep every tag
+    # transient storage errors: capped exponential backoff
+    save_retries: int = 3
+    retry_backoff_s: float = 0.5
+    retry_backoff_max_s: float = 8.0
+    # load-time verification + auto-rollback to the last verified tag
+    verify_on_load: bool = True
+    rollback: bool = True
+    max_rollback: int = 3           # prior tags to try past the newest
+    # preemption-aware shutdown (SIGTERM / cloud-metadata probe →
+    # final synchronous checkpoint + exit 143)
+    preemption_enabled: bool = False
+    preemption_save_dir: str = ""   # "" → the last save_checkpoint dir
+    preemption_grace_s: float = 30.0
+    preemption_probe: str = ""      # "pkg.mod:callable" metadata probe
+    preemption_poll_s: float = 0.0  # 0 → signal-only (no probe thread)
+    # elastic-agent restart hygiene (read by DSElasticAgent from ds_config)
+    restart_backoff_s: float = 1.0
+    restart_backoff_max_s: float = 30.0
+    restart_jitter: float = 0.2
+    stability_window_s: float = 300.0  # uptime that clears restart_count
+
+
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-native mesh axis sizes.  ``-1`` on ``data`` means "everything
     left over".  The product of all axes must equal the device count."""
@@ -307,6 +339,8 @@ class DeepSpeedConfig:
             **pd.get(C.ACTIVATION_CHECKPOINTING, {}))
         self.checkpoint_config = DeepSpeedCheckpointConfig(**pd.get(C.CHECKPOINT, {}))
         self.load_universal_checkpoint = self.checkpoint_config.load_universal
+        self.fault_tolerance_config = DeepSpeedFaultToleranceConfig(
+            **pd.get(C.FAULT_TOLERANCE, {}))
 
         self.eigenvalue_config = EigenvalueConfig(**pd.get(C.EIGENVALUE, {}))
         self.quantize_training_config = QuantizeTrainingConfig(

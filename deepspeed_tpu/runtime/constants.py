@@ -133,6 +133,7 @@ SEQUENCE_PARALLEL = "sequence_parallel"
 # Sub-configs handled by pydantic models
 #############################################
 ZERO_OPTIMIZATION = "zero_optimization"
+FAULT_TOLERANCE = "fault_tolerance"
 ACTIVATION_CHECKPOINTING = "activation_checkpointing"
 COMMS_LOGGER = "comms_logger"
 TELEMETRY = "telemetry"

@@ -50,6 +50,7 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScalerState, create_loss
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.optimizers import get_optimizer
 from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
+from deepspeed_tpu.testing.fault_injection import fault_point
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
                                        FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER,
@@ -211,6 +212,25 @@ class DeepSpeedEngine:
                 batch_size=self.train_batch_size(),
                 steps_per_print=self._config.steps_per_print)
             self.profiler_window = ProfilerWindow.from_config(tcfg)
+
+        # ---- fault tolerance: preemption-aware shutdown ----------------- #
+        # Installed BEFORE the watchdog so the watchdog's SIGTERM chain
+        # terminates at this cooperative flag instead of re-raising to
+        # SIG_DFL — the grace window exists to finish a final checkpoint.
+        self._ckpt_finalizer = None
+        self._ckpt_finalizer_error = None
+        self._last_ckpt_dir = None
+        self._closed = False
+        self.preemption_handler = None
+        ftcfg = self._config.fault_tolerance_config
+        if ftcfg.preemption_enabled:
+            from deepspeed_tpu.runtime.fault_tolerance import (
+                PreemptionHandler, resolve_probe)
+            self.preemption_handler = PreemptionHandler(
+                probe=resolve_probe(ftcfg.preemption_probe),
+                poll_s=ftcfg.preemption_poll_s,
+                telemetry=self.telemetry)
+            self.preemption_handler.install().start()
 
         # ---- span tracing + hang watchdog / flight recorder ------------ #
         # The tracer registers globally so the comm facade and
@@ -1259,6 +1279,10 @@ class DeepSpeedEngine:
             if self.profiler_window is not None:
                 self.profiler_window.step_end(self.global_steps)
             self._report_progress()
+        fault_point("train.step", step=self.global_steps)
+        if (self.preemption_handler is not None
+                and self.preemption_handler.triggered):
+            self._preemption_exit()
 
     def train_batch(self, data_iter=None, batch=None):
         """One full optimizer step over GAS micro-batches in a single XLA
@@ -1438,6 +1462,84 @@ class DeepSpeedEngine:
             if get_global_tracer() is self.tracer:
                 set_global_tracer(None)
             self.tracer.close()
+
+    # ------------------------------------------------------------------ #
+    # Fault tolerance: preemption exit + engine lifecycle
+    # ------------------------------------------------------------------ #
+    def _preemption_exit(self):
+        """Answer a preemption notice: final *synchronous* checkpoint into
+        the configured save dir (falling back to wherever the last
+        checkpoint went), then a clean exit carrying
+        :data:`~deepspeed_tpu.runtime.fault_tolerance.PREEMPTION_EXIT_CODE`
+        so the elastic agent restarts without burning the restart budget."""
+        from deepspeed_tpu.runtime.checkpointing import wait_for_finalizer
+        from deepspeed_tpu.runtime.fault_tolerance import PREEMPTION_EXIT_CODE
+        ftcfg = self._config.fault_tolerance_config
+        reason = (self.preemption_handler.reason
+                  if self.preemption_handler is not None else "unknown")
+        save_dir = ftcfg.preemption_save_dir or self._last_ckpt_dir
+        saved_tag = None
+        if save_dir:
+            try:
+                tag = f"preempt_step{self.global_steps}"
+                self.save_checkpoint(save_dir, tag=tag)
+                # the grace window is all we have: block until durable
+                wait_for_finalizer(self, timeout=ftcfg.preemption_grace_s)
+                saved_tag = tag
+            except Exception as e:
+                logger.error(f"preemption checkpoint failed: {e}")
+        else:
+            logger.warning("preemption: no save dir known (never saved and "
+                           "no preemption_save_dir configured); exiting "
+                           "without a final checkpoint")
+        if self.telemetry is not None:
+            try:
+                self.telemetry.emit(
+                    "preemption",
+                    {"phase": "exit", "reason": reason,
+                     "step": self.global_steps, "dir": str(save_dir or ""),
+                     "tag": saved_tag, "saved": saved_tag is not None},
+                    step=self.global_steps)
+                self.telemetry.flush()
+            except Exception as e:
+                logger.warning(f"preemption telemetry failed: {e}")
+        self.close()
+        raise SystemExit(PREEMPTION_EXIT_CODE)
+
+    def close(self):
+        """Release engine resources: join the async checkpoint finalizer
+        (surfacing, not raising, any stored failure), drain the checkpoint
+        engine, stop the preemption handler, and close telemetry.
+        Idempotent; safe from ``__del__``."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        from deepspeed_tpu.runtime.checkpointing import wait_for_finalizer
+        try:
+            wait_for_finalizer(self, raise_on_error=False)
+        except Exception as e:
+            logger.warning(f"checkpoint finalizer join failed: {e}")
+        ce = getattr(self, "checkpoint_engine", None)
+        if ce is not None:
+            try:
+                ce.wait()
+            except Exception as e:
+                logger.warning(f"checkpoint engine drain failed: {e}")
+        if getattr(self, "preemption_handler", None) is not None:
+            try:
+                self.preemption_handler.stop()
+            except Exception as e:
+                logger.warning(f"preemption handler stop failed: {e}")
+        try:
+            self.telemetry_close()
+        except Exception as e:
+            logger.warning(f"telemetry close failed: {e}")
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _report_progress(self):
         spp = self._config.steps_per_print

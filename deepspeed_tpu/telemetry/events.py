@@ -26,10 +26,15 @@ MOE = "moe_gauge"                  # expert-load / drop-fraction gauges
 COMM_SUMMARY = "comm_summary"      # CommsLogger fold (op counts/bytes/bw)
 FLOPS_BREAKDOWN = "flops_breakdown"  # one-shot per-module FLOPs cost table
 WORKER_EXIT = "worker_exit"        # elastic-agent worker group exit/restart
+CKPT_SAVED = "ckpt_saved"          # one durable (committed+verified) checkpoint
+CKPT_RETRY = "ckpt_retry"          # transient storage error, save being retried
+CKPT_ROLLBACK = "ckpt_rollback"    # corrupt/torn tag skipped at load
+PREEMPTION = "preemption"          # preemption notice / final-checkpoint exit
 SCHEMA = "schema"                  # JSONL header record (written by the sink)
 
 KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
-         WORKER_EXIT, SCHEMA)
+         WORKER_EXIT, CKPT_SAVED, CKPT_RETRY, CKPT_ROLLBACK, PREEMPTION,
+         SCHEMA)
 
 # Every `step` record carries at least these keys once drained.
 STEP_REQUIRED_FIELDS = (

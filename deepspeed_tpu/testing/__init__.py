@@ -1,0 +1,25 @@
+"""deepspeed_tpu.testing — deterministic fault injection for exercising
+the fault-tolerance paths (verified checkpoints, rollback, preemption,
+elastic restart) from plain CPU tests.  See README.md § Fault tolerance."""
+
+from deepspeed_tpu.testing.fault_injection import (PLAN_ENV, FaultInjected,
+                                                   FaultInjector, FaultRule,
+                                                   FaultyCheckpointEngine,
+                                                   bitflip_file, clear_plan,
+                                                   fault_point, get_injector,
+                                                   install_plan,
+                                                   truncate_file)
+
+__all__ = [
+    "PLAN_ENV",
+    "FaultRule",
+    "FaultInjector",
+    "FaultInjected",
+    "FaultyCheckpointEngine",
+    "fault_point",
+    "install_plan",
+    "clear_plan",
+    "get_injector",
+    "bitflip_file",
+    "truncate_file",
+]

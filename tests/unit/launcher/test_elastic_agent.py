@@ -39,14 +39,15 @@ class TestElasticAgent:
             "open(m, 'w').write(str(n + 1))\n"
             "sys.exit(0 if n >= 2 else 7)\n")
         agent = DSElasticAgent(WorkerSpec(_script(tmp_path, body)),
-                               max_restarts=5, monitor_interval=0.1)
+                               max_restarts=5, monitor_interval=0.1,
+                               sleep_fn=lambda s: None)
         assert agent.run() == 0
         assert agent.restart_count == 2
 
     def test_gives_up_after_max_restarts(self, tmp_path):
         agent = DSElasticAgent(
             WorkerSpec(_script(tmp_path, "import sys; sys.exit(3)\n")),
-            max_restarts=2, monitor_interval=0.1)
+            max_restarts=2, monitor_interval=0.1, sleep_fn=lambda s: None)
         assert agent.run() == 3
         assert agent.restart_count == 2
 
@@ -110,7 +111,8 @@ class TestWorkerExitTelemetry:
         hub, ring = self._hub()
         agent = DSElasticAgent(
             WorkerSpec(_script(tmp_path, "import sys; sys.exit(5)\n")),
-            max_restarts=2, monitor_interval=0.1, telemetry=hub)
+            max_restarts=2, monitor_interval=0.1, telemetry=hub,
+            sleep_fn=lambda s: None)
         assert agent.run() == 5
         reasons = [r["reason"] for r in ring.of_kind("worker_exit")]
         assert reasons == ["worker_failure", "worker_failure",
@@ -157,3 +159,98 @@ class TestWorkerExitTelemetry:
                 pytest.fail(f"pid {pid} survived _stop()")
         recs = ring.of_kind("worker_exit")
         assert recs and recs[-1]["reason"] == "test_stop"
+
+
+class TestRestartHygiene:
+    """Backoff, stability-window budget decay, and preemption
+    classification — the elastic half of the fault-tolerance layer."""
+
+    def _hub(self):
+        from deepspeed_tpu.telemetry import RingBufferSink, TelemetryHub
+        ring = RingBufferSink(capacity=64)
+        hub = TelemetryHub(sinks=[ring], flush_every=0,
+                           sync_fn=lambda: None,
+                           memory_stats_fn=lambda: {})
+        return hub, ring
+
+    def test_backoff_sequence_is_exponential(self, tmp_path):
+        sleeps = []
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, "import sys; sys.exit(5)\n")),
+            max_restarts=3, monitor_interval=0.1,
+            restart_backoff_s=0.5, restart_backoff_max_s=30.0,
+            restart_jitter=0.0, sleep_fn=sleeps.append)
+        assert agent.run() == 5
+        assert sleeps == [0.5, 1.0, 2.0]
+
+    def test_backoff_jitter_stays_bounded(self, tmp_path):
+        import random
+        sleeps = []
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, "import sys; sys.exit(5)\n")),
+            max_restarts=3, monitor_interval=0.1,
+            restart_backoff_s=1.0, restart_backoff_max_s=30.0,
+            restart_jitter=0.5, rng=random.Random(0),
+            sleep_fn=sleeps.append)
+        agent.run()
+        for n, d in enumerate(sleeps, start=1):
+            base = 2.0 ** (n - 1)
+            assert 0.5 * base <= d <= 1.5 * base
+
+    def test_ds_config_overrides_backoff_knobs(self, tmp_path):
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, "print('ok')\n")),
+            ds_config={"fault_tolerance": {"restart_backoff_s": 9.0,
+                                           "stability_window_s": 60.0}})
+        assert agent.restart_backoff_s == 9.0
+        assert agent.stability_window_s == 60.0
+
+    def test_preemption_exit_does_not_burn_restart_budget(self, tmp_path):
+        """rc 143 (the preemption convention) restarts immediately:
+        no backoff sleep, restart_count untouched."""
+        marker = tmp_path / "ran"
+        body = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if os.path.exists(m):\n"
+            "    sys.exit(0)\n"
+            "open(m, 'w').write('1')\n"
+            "sys.exit(143)\n")
+        hub, ring = self._hub()
+        sleeps = []
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, body)),
+                               max_restarts=0,   # any crash would give up
+                               monitor_interval=0.1, telemetry=hub,
+                               sleep_fn=sleeps.append)
+        assert agent.run() == 0
+        assert agent.restart_count == 0
+        assert agent.preemption_count == 1
+        assert sleeps == []
+        reasons = [r["reason"] for r in ring.of_kind("worker_exit")]
+        assert reasons == ["preemption", "clean_exit"]
+
+    def test_stability_window_regenerates_budget(self, tmp_path):
+        """With the window at 0 every run counts as stable, so two
+        spaced-out crashes never accumulate past max_restarts=1."""
+        marker = tmp_path / "attempt"
+        body = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+            "open(m, 'w').write(str(n + 1))\n"
+            "sys.exit(0 if n >= 2 else 7)\n")
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, body)),
+                               max_restarts=1, monitor_interval=0.1,
+                               stability_window_s=0.0,
+                               sleep_fn=lambda s: None)
+        assert agent.run() == 0
+
+    def test_worker_exit_payload_carries_hygiene_fields(self, tmp_path):
+        hub, ring = self._hub()
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, "print('ok')\n")),
+                               monitor_interval=0.1, telemetry=hub)
+        assert agent.run() == 0
+        rec = ring.of_kind("worker_exit")[0]
+        assert rec["uptime_s"] is not None and rec["uptime_s"] >= 0
+        assert rec["backoff_s"] == 0.0
+        assert rec["preemption_count"] == 0

@@ -1,0 +1,220 @@
+"""Fault-injection harness tests: rule matching, hit counters, actions,
+env-var plans, and the FaultyCheckpointEngine wrapper.  All in-process
+and fast — the kill/crash actions are exercised end-to-end by the
+subprocess matrix in ``tests/unit/test_crash_recovery.py``."""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.testing.fault_injection import (ACTIONS, PLAN_ENV,
+                                                   FaultInjected,
+                                                   FaultInjector, FaultRule,
+                                                   FaultyCheckpointEngine,
+                                                   bitflip_file, clear_plan,
+                                                   fault_point, get_injector,
+                                                   install_plan,
+                                                   truncate_file)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+class TestFaultRule:
+    def test_fires_on_nth_hit_only(self):
+        inj = FaultInjector([{"site": "s", "action": "raise", "on_hit": 3}])
+        inj.fire("s")
+        inj.fire("s")
+        with pytest.raises(FaultInjected):
+            inj.fire("s")
+        inj.fire("s")                      # times=1: the window has passed
+        assert [e["hit"] for e in inj.log] == [3]
+
+    def test_times_window(self):
+        inj = FaultInjector([{"site": "s", "action": "raise",
+                              "on_hit": 2, "times": 2}])
+        inj.fire("s")
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                inj.fire("s")
+        inj.fire("s")
+
+    def test_match_filters_on_ctx(self):
+        inj = FaultInjector([{"site": "s", "action": "raise",
+                              "match": {"tag": "t2"}}])
+        inj.fire("s", tag="t1")            # no match, counter untouched
+        with pytest.raises(FaultInjected):
+            inj.fire("s", tag="t2")
+
+    def test_site_mismatch_never_counts(self):
+        inj = FaultInjector([{"site": "a", "action": "raise"}])
+        inj.fire("b")
+        inj.fire("b")
+        assert inj.rules[0].hits == 0
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule({"site": "s", "action": "explode"})
+        assert "kill" in ACTIONS
+
+    def test_raise_carries_errno_and_is_oserror(self):
+        inj = FaultInjector([{"site": "s", "action": "raise", "errno": 28,
+                              "message": "disk full"}])
+        with pytest.raises(OSError) as ei:
+            inj.fire("s")
+        assert ei.value.errno == 28
+        assert "disk full" in str(ei.value)
+
+    def test_delay_action_sleeps(self):
+        inj = FaultInjector([{"site": "s", "action": "delay",
+                              "delay_s": 0.05}])
+        t0 = time.monotonic()
+        inj.fire("s")
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_sigterm_action_reaches_handler(self):
+        from deepspeed_tpu.runtime.fault_tolerance import PreemptionHandler
+        h = PreemptionHandler().install()
+        try:
+            inj = FaultInjector([{"site": "s", "action": "sigterm"}])
+            inj.fire("s")
+            for _ in range(100):           # delivery is async-ish
+                if h.triggered:
+                    break
+                time.sleep(0.01)
+            assert h.triggered
+            assert h.reason == f"signal:{int(signal.SIGTERM)}"
+        finally:
+            h.stop()
+
+
+class TestFileCorruption:
+    def test_bitflip_changes_one_byte(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"\x00" * 8)
+        bitflip_file(str(p), offset=3)
+        data = p.read_bytes()
+        assert data[3] == 0xFF and data.count(0) == 7
+
+    def test_bitflip_dir_resolves_deterministically(self, tmp_path):
+        (tmp_path / "b.bin").write_bytes(b"xyz")
+        (tmp_path / "a.bin").write_bytes(b"abc")
+        bitflip_file(str(tmp_path))        # sorted walk: hits a.bin
+        assert (tmp_path / "b.bin").read_bytes() == b"xyz"
+        assert (tmp_path / "a.bin").read_bytes() != b"abc"
+
+    def test_truncate(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"0123456789")
+        truncate_file(str(p), size=4)
+        assert p.read_bytes() == b"0123"
+
+
+class TestGlobalPlan:
+    def test_fault_point_noop_without_plan(self):
+        fault_point("anything", step=1)    # must not raise
+
+    def test_install_and_clear(self):
+        install_plan([{"site": "s", "action": "raise"}])
+        with pytest.raises(FaultInjected):
+            fault_point("s")
+        clear_plan()
+        fault_point("s")
+
+    def test_env_plan_json(self, monkeypatch):
+        monkeypatch.setenv(PLAN_ENV, json.dumps(
+            [{"site": "env.site", "action": "raise"}]))
+        clear_plan()                       # force a fresh env read
+        with pytest.raises(FaultInjected):
+            fault_point("env.site")
+
+    def test_comm_collective_site_fires(self):
+        """comm._log_op carries the comm.collective site (ctx: op) so a
+        plan can delay or fail a staged collective."""
+        from deepspeed_tpu.comm.comm import _log_op
+        install_plan([{"site": "comm.collective", "action": "raise",
+                       "match": {"op": "all_reduce"}}])
+        with _log_op("all_gather", np.zeros(4)):    # filtered out by match
+            pass
+        with pytest.raises(FaultInjected):
+            with _log_op("all_reduce", np.zeros(4)):
+                pass
+
+    def test_env_plan_at_file(self, monkeypatch, tmp_path):
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps([{"site": "f.site", "action": "raise"}]))
+        monkeypatch.setenv(PLAN_ENV, f"@{plan}")
+        clear_plan()
+        assert get_injector() is not None
+        with pytest.raises(FaultInjected):
+            fault_point("f.site")
+
+
+class TestFaultyCheckpointEngine:
+    def _tree(self):
+        return {"a": np.arange(6).reshape(2, 3).astype(np.float32)}
+
+    def test_passthrough_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.checkpoint_engine import LocalCheckpointEngine
+        ce = FaultyCheckpointEngine(LocalCheckpointEngine())
+        tree = self._tree()
+        path = str(tmp_path / "state")
+        ce.create("t")
+        ce.save(tree, path)
+        assert ce.commit("t")
+        back = ce.load(path, target=tree)
+        np.testing.assert_array_equal(back["a"], tree["a"])
+
+    def test_oserror_on_nth_write(self, tmp_path):
+        from deepspeed_tpu.runtime.checkpoint_engine import LocalCheckpointEngine
+        inj = FaultInjector([{"site": "engine.save", "action": "raise",
+                              "on_hit": 2, "errno": 5}])
+        ce = FaultyCheckpointEngine(LocalCheckpointEngine(), injector=inj)
+        tree = self._tree()
+        ce.save(tree, str(tmp_path / "s1"))
+        with pytest.raises(OSError):
+            ce.save(tree, str(tmp_path / "s2"))
+        ce.save(tree, str(tmp_path / "s3"))
+
+    def test_bitflip_after_save_is_silent(self, tmp_path):
+        """post_save bitflip models storage rot: the write call itself
+        succeeds and raises nothing — only a later checksum pass
+        (MANIFEST.json, see test_fault_tolerance) can catch it."""
+        from deepspeed_tpu.runtime.checkpoint_engine import LocalCheckpointEngine
+        work = tmp_path / "tag"
+        inj = FaultInjector([{"site": "engine.post_save", "action": "bitflip",
+                              "path": str(work)}])
+        ce = FaultyCheckpointEngine(LocalCheckpointEngine(), injector=inj)
+        ce.save(self._tree(), str(work / "state"))  # no exception: silent rot
+        assert inj.log and inj.log[0]["site"] == "engine.post_save"
+        # the rot landed in the staged bytes
+        clean = tmp_path / "ref"
+        FaultyCheckpointEngine(LocalCheckpointEngine()).save(
+            self._tree(), str(clean / "state"))
+        assert (work / "state.npz").read_bytes() != \
+            (clean / "state.npz").read_bytes()
+
+    def test_factory_builds_faulty_wrapper(self):
+        from deepspeed_tpu.runtime.checkpoint_engine import (
+            LocalCheckpointEngine, get_checkpoint_engine)
+        ce = get_checkpoint_engine("faulty", config_params={
+            "inner": "local",
+            "plan": [{"site": "engine.commit", "action": "raise"}]})
+        assert isinstance(ce, FaultyCheckpointEngine)
+        assert isinstance(ce.inner, LocalCheckpointEngine)
+        with pytest.raises(FaultInjected):
+            ce.commit("t")
+
+    def test_async_save_delegates_to_inner(self):
+        from deepspeed_tpu.runtime.checkpoint_engine import LocalCheckpointEngine
+        inner = LocalCheckpointEngine()
+        assert FaultyCheckpointEngine(inner).async_save == getattr(
+            inner, "async_save", False)

@@ -1,0 +1,327 @@
+"""Fault-tolerance primitives: manifests + atomic writes, retry backoff,
+the preemption handler, and the offline ``tools/verify_checkpoint.py``
+CLI.  Pure filesystem + stdlib — fast.  The engine-level recovery paths
+(rollback, retention, crash matrix) live in
+``tests/unit/test_crash_recovery.py``."""
+
+import importlib.util
+import json
+import os
+import random
+import signal
+import time
+
+import pytest
+
+from deepspeed_tpu.runtime.checkpoint_engine.manifest import (
+    MANIFEST_FILE, atomic_write_json, atomic_write_text, crc32_file,
+    manifest_ok, verify_manifest, write_manifest)
+from deepspeed_tpu.runtime.fault_tolerance import (PREEMPTION_EXIT_CODE,
+                                                   CheckpointWriteError,
+                                                   PreemptionHandler,
+                                                   backoff_delay,
+                                                   resolve_probe,
+                                                   retry_transient)
+from deepspeed_tpu.testing.fault_injection import bitflip_file, truncate_file
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+verify_checkpoint = _load_tool("verify_checkpoint")
+
+
+def _make_ckpt(tag_dir, payload=b"checkpoint-bytes" * 64):
+    os.makedirs(os.path.join(tag_dir, "state"), exist_ok=True)
+    with open(os.path.join(tag_dir, "state", "shard0.bin"), "wb") as f:
+        f.write(payload)
+    atomic_write_json(os.path.join(tag_dir, "client_state.json"),
+                      {"global_steps": 1})
+    return write_manifest(tag_dir, extra={"tag": os.path.basename(tag_dir)})
+
+
+class TestManifest:
+    def test_roundtrip_verifies(self, tmp_path):
+        m = _make_ckpt(str(tmp_path / "t"))
+        assert m["file_count"] == 2 and m["total_bytes"] > 0
+        rep = verify_manifest(str(tmp_path / "t"))
+        assert rep["status"] == "verified"
+        assert rep["checked"] == 2 and not rep["errors"]
+
+    def test_bitflip_caught(self, tmp_path):
+        d = str(tmp_path / "t")
+        _make_ckpt(d)
+        bitflip_file(os.path.join(d, "state", "shard0.bin"))
+        rep = verify_manifest(d)
+        assert rep["status"] == "corrupt"
+        assert rep["errors"][0]["error"] == "checksum_mismatch"
+        ok, _ = manifest_ok(d)
+        assert not ok
+
+    def test_torn_write_caught_without_crc(self, tmp_path):
+        d = str(tmp_path / "t")
+        _make_ckpt(d)
+        truncate_file(os.path.join(d, "state", "shard0.bin"), size=7)
+        rep = verify_manifest(d, deep=False)
+        assert rep["status"] == "corrupt"
+        assert rep["errors"][0]["error"] == "size_mismatch"
+
+    def test_missing_file_caught(self, tmp_path):
+        d = str(tmp_path / "t")
+        _make_ckpt(d)
+        os.remove(os.path.join(d, "state", "shard0.bin"))
+        rep = verify_manifest(d)
+        assert rep["errors"][0]["error"] == "missing"
+
+    def test_unlisted_extra_file_reported_not_fatal(self, tmp_path):
+        d = str(tmp_path / "t")
+        _make_ckpt(d)
+        with open(os.path.join(d, "stray.txt"), "w") as f:
+            f.write("x")
+        rep = verify_manifest(d)
+        assert rep["status"] == "verified"
+        assert rep["extra_files"] == ["stray.txt"]
+
+    def test_legacy_checkpoint_without_manifest_is_ok(self, tmp_path):
+        d = str(tmp_path / "t")
+        os.makedirs(d)
+        rep = verify_manifest(d)
+        assert rep["status"] == "no_manifest"
+        ok, _ = manifest_ok(d)
+        assert ok
+
+    def test_corrupted_manifest_itself(self, tmp_path):
+        d = str(tmp_path / "t")
+        _make_ckpt(d)
+        with open(os.path.join(d, MANIFEST_FILE), "w") as f:
+            f.write("{not json")
+        assert verify_manifest(d)["status"] == "corrupt"
+
+    def test_crc32_is_stable(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_bytes(b"abc")
+        import zlib
+        assert crc32_file(str(p)) == zlib.crc32(b"abc")
+
+
+class TestAtomicWrite:
+    def test_replace_not_truncate(self, tmp_path):
+        p = str(tmp_path / "latest")
+        atomic_write_text(p, "global_step1")
+        atomic_write_text(p, "global_step2")
+        with open(p) as f:
+            assert f.read() == "global_step2"
+        # no tmp droppings
+        assert os.listdir(tmp_path) == ["latest"]
+
+    def test_json_helper(self, tmp_path):
+        p = str(tmp_path / "client_state.json")
+        atomic_write_json(p, {"b": 2, "a": 1})
+        with open(p) as f:
+            assert json.load(f) == {"a": 1, "b": 2}
+
+
+class TestBackoff:
+    def test_exponential_and_capped(self):
+        delays = [backoff_delay(n, 0.5, 4.0, jitter=0.0)
+                  for n in range(1, 6)]
+        assert delays == [0.5, 1.0, 2.0, 4.0, 4.0]
+
+    def test_jitter_bounds(self):
+        rng = random.Random(7)
+        for n in range(1, 8):
+            d = backoff_delay(n, 1.0, 100.0, jitter=0.25, rng=rng)
+            base = min(100.0, 2.0 ** (n - 1))
+            assert 0.75 * base <= d <= 1.25 * base
+
+    def test_retry_recovers(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError(5, "transient")
+            return "ok"
+
+        out = retry_transient(flaky, retries=3, base_s=0.5, max_s=8.0,
+                              jitter=0.0, sleep_fn=sleeps.append)
+        assert out == "ok"
+        assert sleeps == [0.5, 1.0]
+
+    def test_retry_exhausts_and_raises_original(self):
+        sleeps = []
+        with pytest.raises(OSError):
+            retry_transient(lambda: (_ for _ in ()).throw(OSError(5, "x")),
+                            retries=2, jitter=0.0, sleep_fn=sleeps.append)
+        assert len(sleeps) == 2
+
+    def test_non_retryable_passes_through_immediately(self):
+        with pytest.raises(ValueError):
+            retry_transient(lambda: (_ for _ in ()).throw(ValueError("x")),
+                            retries=5, sleep_fn=lambda s: pytest.fail(
+                                "slept on a non-retryable error"))
+
+    def test_on_retry_observer_sees_attempts(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 1:
+                raise OSError(5, "once")
+            return 1
+
+        retry_transient(flaky, retries=2, jitter=0.0,
+                        on_retry=lambda a, d, e: seen.append((a, d)),
+                        sleep_fn=lambda s: None)
+        assert seen == [(1, 0.5)]
+
+
+class TestPreemptionHandler:
+    def test_sigterm_sets_flag_and_process_survives(self):
+        h = PreemptionHandler().install()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(200):
+                if h.triggered:
+                    break
+                time.sleep(0.01)
+            assert h.triggered
+        finally:
+            h.stop()
+        # stop() restored the previous disposition
+        assert signal.getsignal(signal.SIGTERM) != h._on_signal
+
+    def test_chains_previous_callable_handler(self):
+        hits = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+        h = PreemptionHandler().install()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(200):
+                if hits:
+                    break
+                time.sleep(0.01)
+            assert h.triggered and hits == [signal.SIGTERM]
+        finally:
+            h.stop()
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_probe_triggers_via_check(self):
+        state = {"doomed": False}
+        h = PreemptionHandler(probe=lambda: state["doomed"])
+        assert h.check() is False
+        state["doomed"] = True
+        assert h.check() is True
+        assert h.triggered and h.reason == "probe"
+
+    def test_probe_poll_thread(self):
+        state = {"doomed": False}
+        h = PreemptionHandler(probe=lambda: state["doomed"],
+                              poll_s=0.01).start()
+        try:
+            state["doomed"] = True
+            for _ in range(300):
+                if h.triggered:
+                    break
+                time.sleep(0.01)
+            assert h.triggered
+        finally:
+            h.stop()
+
+    def test_failing_probe_never_kills(self):
+        h = PreemptionHandler(probe=lambda: 1 / 0)
+        assert h.check() is False
+
+    def test_trigger_emits_telemetry_notice(self):
+        from deepspeed_tpu.telemetry import RingBufferSink, TelemetryHub
+        ring = RingBufferSink(capacity=8)
+        hub = TelemetryHub(sinks=[ring], flush_every=0, sync_fn=lambda: None,
+                           memory_stats_fn=lambda: {})
+        h = PreemptionHandler(telemetry=hub)
+        h.trigger("test")
+        h.trigger("again")                 # idempotent: first reason wins
+        recs = ring.of_kind("preemption")
+        assert len(recs) == 1
+        assert recs[0]["phase"] == "notice" and recs[0]["reason"] == "test"
+        assert h.reason == "test"
+
+    def test_exit_code_is_unhandled_sigterm_convention(self):
+        assert PREEMPTION_EXIT_CODE == 128 + int(signal.SIGTERM) == 143
+
+
+class TestResolveProbe:
+    def test_empty_disables(self):
+        assert resolve_probe("") is None
+
+    def test_resolves_callable(self):
+        fn = resolve_probe("os.path:isdir")
+        assert callable(fn)
+
+    def test_bad_spec_warns_not_raises(self):
+        assert resolve_probe("no.such.module:fn") is None
+        assert resolve_probe("os.path:not_a_thing") is None
+        assert resolve_probe("os.path:sep") is None   # not callable
+
+
+class TestVerifyCheckpointCLI:
+    def _save_dir(self, tmp_path, tags=("global_step1", "global_step2")):
+        d = str(tmp_path / "ck")
+        for t in tags:
+            _make_ckpt(os.path.join(d, t))
+        atomic_write_text(os.path.join(d, "latest"), tags[-1])
+        return d
+
+    def test_clean_exit_zero(self, tmp_path, capsys):
+        d = self._save_dir(tmp_path)
+        assert verify_checkpoint.main([d]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] and out["verified"] == 1
+        assert out["reports"][0]["tag"] == "global_step2"
+
+    def test_corrupt_exit_one_and_report(self, tmp_path, capsys):
+        d = self._save_dir(tmp_path)
+        bitflip_file(os.path.join(d, "global_step2", "state"))
+        rc = verify_checkpoint.main([d, "--all", "--json",
+                                     str(tmp_path / "rep.json")])
+        assert rc == 1
+        out = json.loads((tmp_path / "rep.json").read_text())
+        assert out["corrupt"] == 1 and out["verified"] == 1
+        bad = [r for r in out["reports"] if r["status"] == "corrupt"]
+        assert bad[0]["tag"] == "global_step2"
+        assert bad[0]["errors"][0]["error"] == "checksum_mismatch"
+
+    def test_single_tag_dir_and_shallow(self, tmp_path, capsys):
+        d = self._save_dir(tmp_path)
+        tag_dir = os.path.join(d, "global_step1")
+        assert verify_checkpoint.main([tag_dir, "--shallow"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["deep"] is False
+
+    def test_explicit_tag(self, tmp_path, capsys):
+        d = self._save_dir(tmp_path)
+        assert verify_checkpoint.main([d, "--tag", "global_step1"]) == 0
+
+    def test_usage_errors_exit_two(self, tmp_path, capsys):
+        assert verify_checkpoint.main([str(tmp_path / "nope")]) == 2
+        d = self._save_dir(tmp_path)
+        assert verify_checkpoint.main([d, "--tag", "ghost"]) == 2
+        os.remove(os.path.join(d, "latest"))
+        # a save dir without 'latest' needs --tag/--all
+        assert verify_checkpoint.main([d]) == 2
+        assert verify_checkpoint.main([d, "--all"]) == 0
+        capsys.readouterr()
+
+    def test_truncated_latest_pointer(self, tmp_path, capsys):
+        d = self._save_dir(tmp_path)
+        with open(os.path.join(d, "latest"), "w") as f:
+            f.write("torn_tag_name")
+        assert verify_checkpoint.main([d]) == 2
+        capsys.readouterr()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Offline checkpoint verifier.
+
+Replays the same manifest walk the loader runs before trusting a tag
+(``runtime/checkpoint_engine/manifest.py``), but from the shell — for
+pre-flight checks before a long resume, post-incident forensics, and CI.
+
+Usage::
+
+    python tools/verify_checkpoint.py CKPT_PATH [--tag TAG] [--all]
+                                      [--shallow] [--json OUT]
+
+``CKPT_PATH`` may be a *save dir* (holding ``latest`` + tag dirs) or a
+single *tag dir* (holding ``MANIFEST.json``).  For a save dir the default
+is to verify the tag ``latest`` points at; ``--tag`` picks one tag and
+``--all`` sweeps every visible tag.  ``--shallow`` checks existence+size
+only (skips CRC-32 — useful on multi-hundred-GB checkpoints).
+
+Prints a JSON report (also written to ``--json`` if given) and exits 0
+when everything verified, 1 when anything is corrupt, 2 on usage errors
+(path missing, tag not found).  ``no_manifest`` (a pre-manifest legacy
+checkpoint) is reported but does not fail the run — there is nothing to
+verify against.
+
+Standard library only: runs anywhere the checkpoint is mounted, no jax.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from deepspeed_tpu.runtime.checkpoint_engine.manifest import (  # noqa: E402
+    MANIFEST_FILE, verify_manifest)
+
+LATEST_FILE = "latest"
+
+
+def _is_tag_dir(path: str) -> bool:
+    return (os.path.isfile(os.path.join(path, MANIFEST_FILE))
+            or os.path.isdir(os.path.join(path, "state"))
+            or os.path.isfile(os.path.join(path, "state.npz"))
+            or os.path.isfile(os.path.join(path, "client_state.json")))
+
+
+def _list_tags(save_dir: str):
+    try:
+        names = os.listdir(save_dir)
+    except OSError:
+        return []
+    return sorted(n for n in names
+                  if not n.startswith(".")
+                  and os.path.isdir(os.path.join(save_dir, n))
+                  and _is_tag_dir(os.path.join(save_dir, n)))
+
+
+def _resolve_targets(path: str, tag, verify_all: bool):
+    """→ (list of (tag, dir) to verify, error string or None)."""
+    if not os.path.isdir(path):
+        return [], f"{path}: not a directory"
+    if _is_tag_dir(path) and tag is None and not verify_all:
+        return [(os.path.basename(os.path.normpath(path)), path)], None
+    if tag is not None:
+        d = os.path.join(path, tag)
+        if not os.path.isdir(d):
+            return [], f"tag {tag!r} not found under {path}"
+        return [(tag, d)], None
+    if verify_all:
+        tags = _list_tags(path)
+        if not tags:
+            return [], f"no checkpoint tags under {path}"
+        return [(t, os.path.join(path, t)) for t in tags], None
+    latest = os.path.join(path, LATEST_FILE)
+    if not os.path.isfile(latest):
+        return [], (f"{path}: neither a tag dir nor a save dir with a "
+                    f"'{LATEST_FILE}' file (use --tag or --all)")
+    try:
+        with open(latest) as f:
+            t = f.read().strip()
+    except OSError as e:
+        return [], f"unreadable {latest}: {e}"
+    d = os.path.join(path, t)
+    if not os.path.isdir(d):
+        return [], f"'{LATEST_FILE}' points at missing tag {t!r}"
+    return [(t, d)], None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Verify checkpoint integrity against MANIFEST.json")
+    ap.add_argument("path", help="save dir or single tag dir")
+    ap.add_argument("--tag", default=None, help="verify this tag only")
+    ap.add_argument("--all", action="store_true", dest="verify_all",
+                    help="verify every tag under the save dir")
+    ap.add_argument("--shallow", action="store_true",
+                    help="skip CRC-32 (existence + size only)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    targets, err = _resolve_targets(args.path, args.tag, args.verify_all)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    reports = []
+    for t, d in targets:
+        rep = verify_manifest(d, deep=not args.shallow)
+        rep["tag"] = t
+        reports.append(rep)
+
+    corrupt = [r for r in reports if r["status"] == "corrupt"]
+    out = {
+        "path": args.path,
+        "deep": not args.shallow,
+        "verified": sum(r["status"] == "verified" for r in reports),
+        "no_manifest": sum(r["status"] == "no_manifest" for r in reports),
+        "corrupt": len(corrupt),
+        "ok": not corrupt,
+        "reports": reports,
+    }
+    text = json.dumps(out, indent=2, sort_keys=True)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+    return 1 if corrupt else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
