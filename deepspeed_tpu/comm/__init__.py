@@ -1,5 +1,7 @@
 from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_reduce, all_to_all, barrier, broadcast,
-                                     broadcast_object_list, configure_comms_logger, get_axis_index,
-                                     get_axis_size, get_device_count, get_local_rank, get_rank,
-                                     get_world_size, init_distributed, is_initialized, log_summary,
-                                     ppermute, reduce_scatter, send_recv_next, send_recv_prev)
+                                     broadcast_object_list, compressed_op_span, configure_comms_logger,
+                                     get_axis_index, get_axis_size, get_device_count, get_local_rank,
+                                     get_rank, get_world_size, init_distributed, is_initialized,
+                                     log_summary, ppermute, reduce_scatter, send_recv_next,
+                                     send_recv_prev)
+from deepspeed_tpu.comm import compression

@@ -187,6 +187,27 @@ def _log_op(name: str, tensor, group=None):
         yield
 
 
+@contextmanager
+def compressed_op_span(name: str, logical_bytes: int, wire_bytes: int,
+                       group=None):
+    """Span hook for compressed collectives (qwZ/qgZ/hpZ) carrying BOTH
+    logical and on-wire byte counts so compression ratio is readable
+    straight off the trace.  Trace-time only, like ``_log_op`` — but no
+    CommsLogger append here: compressed ops run every executed step while
+    this context fires once per compile, so the engine accounts per-step
+    bytes itself from the same accounting helpers."""
+    fault_point("comm.collective", op=name)
+    tracer = get_global_tracer()
+    if tracer is None:
+        yield
+        return
+    axis = group if isinstance(group, (str, type(None))) else "+".join(group)
+    with tracer.span(f"comm.{name}", op=name, axis=axis,
+                     logical_bytes=int(logical_bytes),
+                     wire_bytes=int(wire_bytes)):
+        yield
+
+
 # --------------------------------------------------------------------------- #
 # In-program collectives (use inside jit/shard_map; `group` = mesh axis name)
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,39 @@
+"""Compressed-collective subsystem (ZeRO++, arxiv 2306.10209).
+
+Layered on ``comm/comm.py``: blockwise quantization core + three
+topology-aware collectives that cut ZeRO-3 wire volume —
+
+* ``qwz``  — quantized weight all-gather
+* ``qgz``  — hierarchical quantized gradient reduce-scatter
+* ``hpz``  — secondary intra-host weight shard (slow-axis-free regathers)
+"""
+
+from deepspeed_tpu.comm.compression.core import (  # noqa: F401
+    SCALE_BYTES,
+    ZERO_BYTES,
+    CompressionState,
+    QuantizedBlocks,
+    dequantize_blockwise,
+    ef_compensate,
+    ef_quantize,
+    ef_residual,
+    init_compression_state,
+    n_blocks,
+    padded_size,
+    quantization_error_bound,
+    quantize_blockwise,
+    quantized_nbytes,
+    sign_scale,
+)
+from deepspeed_tpu.comm.compression.hpz import (  # noqa: F401
+    fast_regather,
+    hierarchical_gather,
+)
+from deepspeed_tpu.comm.compression.qgz import (  # noqa: F401
+    hierarchical_reduce_scatter,
+    quantized_reduce_scatter_1d,
+)
+from deepspeed_tpu.comm.compression.qwz import (  # noqa: F401
+    merge_at_dim,
+    quantized_all_gather,
+)

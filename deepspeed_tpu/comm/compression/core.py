@@ -1,0 +1,173 @@
+"""Blockwise quantization core shared by every compressed collective.
+
+ZeRO++ (arxiv 2306.10209) compresses collective payloads with *blockwise*
+quantization: each block of ``block_size`` contiguous elements carries its
+own fp32 scale + zero-point, so one outlier only degrades its block instead
+of the whole tensor.  This module owns that math plus the error-feedback
+state machinery, so the three ZeRO++ collectives (``qwz``/``qgz``/``hpz``)
+and the older 1-bit compensated allreduce
+(``runtime/comm/compressed.py``) all quantize through one code path.
+
+Everything here is a pure jit-safe function: shapes, bit widths and block
+sizes are static, values are traced.  4-bit payloads are nibble-packed into
+uint8 so the array that actually crosses the wire has the advertised size —
+the comms logger and ``tools/comm_audit.py`` account real bytes, not
+"conceptual" ones.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SCALE_BYTES = 4   # fp32 per-block scale
+ZERO_BYTES = 4    # fp32 per-block zero-point
+
+
+class QuantizedBlocks(NamedTuple):
+    """A blockwise-quantized tensor: the three arrays a compressed
+    collective moves.  Static metadata (bits, block_size, original length)
+    is the caller's — inside jit it must be python-level anyway."""
+    data: jax.Array    # uint8 [..., nb, block] (8-bit) or [..., nb, block//2] (4-bit packed)
+    scale: jax.Array   # f32 [..., nb]
+    zero: jax.Array    # f32 [..., nb]  (block minimum — asymmetric zero-point)
+
+
+def n_blocks(m: int, block_size: int) -> int:
+    return -(-m // block_size)
+
+
+def quantized_nbytes(m: int, bits: int = 8, block_size: int = 256) -> int:
+    """Wire bytes of ``quantize_blockwise`` applied to m elements: packed
+    payload + per-block scale/zero-point.  The accounting counterpart the
+    engine and bench use for logical-vs-wire reporting."""
+    nb = n_blocks(m, block_size)
+    payload = nb * block_size * bits // 8
+    return payload + nb * (SCALE_BYTES + ZERO_BYTES)
+
+
+def _check(bits: int, block_size: int):
+    assert bits in (4, 8), f"bits must be 4 or 8, got {bits}"
+    assert block_size > 0 and block_size % 2 == 0, (
+        f"block_size must be positive and even (4-bit packing), got {block_size}")
+
+
+def _pack4(q):
+    """Two 4-bit codes per byte (low nibble first)."""
+    return (q[..., ::2] | (q[..., 1::2] << 4)).astype(jnp.uint8)
+
+
+def _unpack4(p):
+    lo = p & jnp.uint8(0x0F)
+    hi = (p >> 4) & jnp.uint8(0x0F)
+    return jnp.stack([lo, hi], axis=-1).reshape(*p.shape[:-1], p.shape[-1] * 2)
+
+
+def quantize_blockwise(x, bits: int = 8, block_size: int = 256) -> QuantizedBlocks:
+    """Quantize along the LAST axis in independent ``block_size`` blocks
+    with a per-block fp32 scale + zero-point (asymmetric uint codes).
+
+    The last block is edge-padded — padding repeats the final element so it
+    cannot widen the block's value range (a zero pad would inflate the
+    quantization step of every tail block of an all-positive tensor).
+    Leading axes are batch axes: each row quantizes independently, which is
+    what lets ``qgz`` all-to-all per-peer rows without blocks straddling
+    peer boundaries.
+    """
+    _check(bits, block_size)
+    x = jnp.asarray(x)
+    m = x.shape[-1]
+    nb = n_blocks(m, block_size)
+    pad = nb * block_size - m
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)], mode="edge")
+    y = x.reshape(*x.shape[:-1], nb, block_size).astype(jnp.float32)
+    mn = y.min(axis=-1)
+    mx = y.max(axis=-1)
+    qmax = (1 << bits) - 1
+    # constant block → scale 1, every code 0, dequant returns mn exactly
+    scale = jnp.where(mx > mn, (mx - mn) / qmax, 1.0)
+    q = jnp.clip(jnp.round((y - mn[..., None]) / scale[..., None]), 0, qmax)
+    q = q.astype(jnp.uint8)
+    if bits == 4:
+        q = _pack4(q)
+    return QuantizedBlocks(q, scale, mn)
+
+
+def dequantize_blockwise(q: QuantizedBlocks, m: int, bits: int = 8,
+                         dtype=jnp.float32) -> jax.Array:
+    """Invert ``quantize_blockwise``: (..., nb, block) codes → (..., m)."""
+    _check(bits, q.data.shape[-1] * (2 if bits == 4 else 1))
+    codes = _unpack4(q.data) if bits == 4 else q.data
+    y = codes.astype(jnp.float32) * q.scale[..., None] + q.zero[..., None]
+    y = y.reshape(*y.shape[:-2], y.shape[-2] * y.shape[-1])
+    return y[..., :m].astype(dtype)
+
+
+def quantization_error_bound(x: np.ndarray, bits: int, block_size: int) -> np.ndarray:
+    """Per-element worst-case round-trip error: half a quantization step of
+    the element's block.  Host-side helper for tests/analysis."""
+    x = np.asarray(x, np.float32)
+    m = x.shape[-1]
+    nb = n_blocks(m, block_size)
+    pad = nb * block_size - m
+    if pad:
+        x = np.concatenate([x, np.repeat(x[..., -1:], pad, axis=-1)], axis=-1)
+    y = x.reshape(*x.shape[:-1], nb, block_size)
+    step = (y.max(-1) - y.min(-1)) / ((1 << bits) - 1)
+    bound = np.repeat(step[..., None], block_size, axis=-1)
+    return bound.reshape(*bound.shape[:-2], nb * block_size)[..., :m] / 2 + 1e-6
+
+
+# --------------------------------------------------------------------------- #
+# Error feedback — the residual-compensation pattern every lossy exchange
+# shares.  The state SHAPE is the 1-bit path's ``CompressionState`` (that
+# path now imports it from here); blockwise users carry the same two flat
+# buffers.
+# --------------------------------------------------------------------------- #
+class CompressionState(NamedTuple):
+    """Per-device error-feedback buffers (flat, padded)."""
+    worker_error: jax.Array   # [n_padded]          local quantization residual
+    server_error: jax.Array   # [n_padded / world]  residual of the served chunk
+
+
+def padded_size(n: int, world: int) -> int:
+    return -(-n // world) * world
+
+
+def init_compression_state(n: int, world: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Zero-initialized (worker_error, server_error) for a flat size n."""
+    np_ = padded_size(n, world)
+    return (np.zeros((np_,), np.float32), np.zeros((np_ // world,), np.float32))
+
+
+def ef_compensate(x, residual):
+    """Fold the carried residual into the value about to be compressed."""
+    return x + residual
+
+
+def ef_residual(compensated, decompressed):
+    """What the lossy representation failed to carry — next call's residual."""
+    return compensated - decompressed
+
+
+def sign_scale(x):
+    """The 1-bit compressor: elementwise sign + one fp32 scale
+    (``||x|| / sqrt(n)``) — reference ``NcclBackend.compressed_allreduce``
+    worker/server compression."""
+    scale = jnp.linalg.norm(x) / jnp.sqrt(jnp.asarray(x.size, jnp.float32))
+    sign = jnp.where(x >= 0, 1, -1).astype(jnp.int8)
+    return sign, scale
+
+
+def ef_quantize(x, residual, bits: int = 8,
+                block_size: int = 256) -> Tuple[QuantizedBlocks, jax.Array]:
+    """Blockwise quantization with error feedback: compress
+    ``x + residual``, return (codes, new_residual).  Repeated application
+    with a persistent residual makes the time-average of the decompressed
+    stream converge to the true value even at 4 bits."""
+    compensated = ef_compensate(x, residual)
+    q = quantize_blockwise(compensated, bits=bits, block_size=block_size)
+    deq = dequantize_blockwise(q, compensated.shape[-1], bits=bits)
+    return q, ef_residual(compensated, deq)

@@ -1,0 +1,97 @@
+"""qwZ — quantized weight all-gather (ZeRO++ §4.1).
+
+ZeRO-3 all-gathers every parameter shard at its use site; qwZ sends the
+shard as blockwise uint8 codes + per-block scales instead of full-precision
+elements, cutting all-gather wire volume ~4x (fp32 compute) / ~2x (bf16).
+Receivers dequantize locally — lossy for the forward weights only, which is
+the paper's tolerance argument (gradients w.r.t. the *dequantized* weights
+stay consistent because the same dequantized values are used everywhere).
+
+Call inside ``shard_map``.  ``axes`` is the tuple of mesh axes the shard
+dim is partitioned over, MAJOR → MINOR (partition-spec order); the gather
+runs minor-axis first so the leading group index of the collected parts is
+major-axis-major, i.e. exactly the concatenation order of a tiled
+``lax.all_gather`` over the same axes.
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+from deepspeed_tpu.comm.compression import core
+
+
+def _axes_world(axes: Sequence[str]) -> int:
+    w = 1
+    for a in axes:
+        w *= mesh_lib.manual_axis_size(a)
+    return w
+
+
+def merge_at_dim(parts: jax.Array, dim: int) -> jax.Array:
+    """[W, *shard] stacked members → shard concatenated at ``dim``
+    (member-major — the tiled all_gather layout)."""
+    shape = parts.shape
+    out = jnp.moveaxis(parts, 0, dim)
+    return out.reshape(shape[1:1 + dim] + (shape[0] * shape[1 + dim],)
+                       + shape[2 + dim:])
+
+
+def quantized_all_gather(x: jax.Array, axes: Sequence[str], dim: int = 0,
+                         bits: int = 8, block_size: int = 256,
+                         out_dtype=jnp.float32) -> jax.Array:
+    """All-gather ``x`` (this device's shard) along ``dim`` over ``axes``
+    with a blockwise-quantized wire format.
+
+    Parity contract (see tests): equals
+    ``lax.all_gather(x, axes, axis=dim, tiled=True)`` up to the per-block
+    quantization error bound — and exactly when shard values sit on their
+    block's quantization lattice.
+    """
+    from deepspeed_tpu.comm.comm import compressed_op_span
+
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    shard_shape = x.shape
+    m = x.size
+    q = core.quantize_blockwise(x.reshape(-1), bits=bits, block_size=block_size)
+
+    world = _axes_world(axes)
+    with compressed_op_span(
+            "qwz_all_gather",
+            logical_bytes=logical_bytes(m, world, jnp.dtype(out_dtype).itemsize),
+            wire_bytes=wire_bytes(m, world, bits, block_size),
+            group=axes):
+        parts = q
+        # minor axis first: after the loop the leading group dims read
+        # (W_major, ..., W_minor) and flatten to the tiled member order.
+        for ax in reversed(axes):
+            parts = core.QuantizedBlocks(
+                lax.all_gather(parts.data, ax, axis=0, tiled=False),
+                lax.all_gather(parts.scale, ax, axis=0, tiled=False),
+                lax.all_gather(parts.zero, ax, axis=0, tiled=False))
+
+    def flat_members(a):
+        return a.reshape((world,) + a.shape[len(axes):])
+
+    gathered = core.QuantizedBlocks(*(flat_members(a) for a in parts))
+    members = core.dequantize_blockwise(gathered, m, bits=bits, dtype=out_dtype)
+    return merge_at_dim(members.reshape((world,) + shard_shape), dim)
+
+
+# --------------------------------------------------------------------------- #
+# Byte accounting (per device, receive-side — matches the fp32 ring
+# convention the 1-bit path's ``compressed_bytes`` established).
+# --------------------------------------------------------------------------- #
+def wire_bytes(shard_elems: int, world: int, bits: int = 8,
+               block_size: int = 256) -> int:
+    """Bytes received per device: (world-1) peers' quantized shards."""
+    return (world - 1) * core.quantized_nbytes(shard_elems, bits, block_size)
+
+
+def logical_bytes(shard_elems: int, world: int, itemsize: int = 4) -> int:
+    """What the uncompressed all-gather of the same shards would move."""
+    return (world - 1) * shard_elems * itemsize

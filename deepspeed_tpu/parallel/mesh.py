@@ -81,6 +81,17 @@ class MeshSpec:
             assert device_count % model == 0
             fsdp = device_count // model
             data = 1
+            # hpZ (ZeRO++): shrink the fsdp axis to the secondary-partition
+            # size and put the rest on data, so the (data, fsdp) split IS the
+            # (slow, fast) topology the compressed collectives key off.
+            hpz = getattr(ds_config.zero_config, "zero_hpz_partition_size", 1)
+            if ds_config.zero_config.stage >= 3 and hpz > 1:
+                assert fsdp % hpz == 0, (
+                    f"zero_hpz_partition_size {hpz} must divide the ZeRO "
+                    f"world size {fsdp}")
+                if fsdp // hpz > 1:
+                    data = fsdp // hpz
+                    fsdp = hpz
         else:
             data = m.data
         return cls(pipe=pp, data=data, fsdp=fsdp, expert=max(m.expert, 1), seq=sp,
@@ -206,6 +217,34 @@ def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
 def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
     mesh = mesh or get_mesh()
     return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across JAX versions.  Newer releases expose it at
+    the top level with ``check_vma``; older ones only have
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep`` (same
+    meaning).  New subsystems route through this so they run on either."""
+    import inspect
+    if hasattr(jax, "shard_map"):
+        sm = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as sm
+    kw = ("check_vma" if "check_vma" in inspect.signature(sm).parameters
+          else "check_rep")
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{kw: check_vma})
+
+
+def manual_axis_size(name: str) -> int:
+    """Static size of a named mesh axis from inside a ``shard_map`` body,
+    across JAX versions (``lax.axis_size`` is newer than the pinned
+    toolchain; older releases answer via ``core.axis_frame``)."""
+    from jax import lax as _lax
+    if hasattr(_lax, "axis_size"):
+        return int(_lax.axis_size(name))
+    from jax import core as _core
+    frame = _core.axis_frame(name)
+    return int(getattr(frame, "size", frame))
 
 
 @functools.lru_cache(None)

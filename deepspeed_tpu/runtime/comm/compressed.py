@@ -20,29 +20,24 @@ TPU-native design: the whole algorithm is a pure function over
 ``shard_map`` over the data-parallel mesh axis; the error buffers are the
 caller's state (the engine stores them sharded one-per-device).  No CUDA
 streams, no cupy: XLA schedules the collectives on ICI.
+
+The compressor and its error-feedback state live in
+``comm/compression/core`` — shared with the ZeRO++ blockwise collectives —
+and are re-exported here so the public surface of this module is unchanged.
 """
 
-from typing import NamedTuple, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+from deepspeed_tpu.comm.compression.core import (  # noqa: F401 — public API
+    CompressionState, ef_compensate, ef_residual, init_compression_state,
+    padded_size, sign_scale)
+from deepspeed_tpu.parallel import mesh as mesh_lib
 
-class CompressionState(NamedTuple):
-    """Per-device error-feedback buffers (flat, padded)."""
-    worker_error: jax.Array   # [n_padded]     local quantization residual
-    server_error: jax.Array   # [n_padded / world]  residual of the served chunk
-
-
-def padded_size(n: int, world: int) -> int:
-    return -(-n // world) * world
-
-
-def init_compression_state(n: int, world: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Zero-initialized (worker_error, server_error) for a flat size n."""
-    np_ = padded_size(n, world)
-    return (np.zeros((np_,), np.float32), np.zeros((np_ // world,), np.float32))
+# kept under its historical private name for callers that reached in
+_sign_scale = sign_scale
 
 
 def compressed_bytes(n: int, world: int) -> int:
@@ -54,12 +49,6 @@ def compressed_bytes(n: int, world: int) -> int:
     return (world - 1) * chunk + (world - 1) * chunk + 2 * 4 * (world - 1)
 
 
-def _sign_scale(x):
-    scale = jnp.linalg.norm(x) / jnp.sqrt(jnp.asarray(x.size, jnp.float32))
-    sign = jnp.where(x >= 0, 1, -1).astype(jnp.int8)
-    return sign, scale
-
-
 def compressed_allreduce(x: jax.Array, state: CompressionState,
                          axis_name: str) -> Tuple[jax.Array, CompressionState]:
     """Compensated 1-bit mean over ``axis_name`` (call inside shard_map).
@@ -67,7 +56,7 @@ def compressed_allreduce(x: jax.Array, state: CompressionState,
     ``x`` is this device's flat fp32 vector (unpadded length); returns the
     compressed mean (same shape) and the updated error buffers.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = mesh_lib.manual_axis_size(axis_name)
     n = x.shape[0]
     n_pad = state.worker_error.shape[0]
     chunk = n_pad // world
@@ -75,9 +64,9 @@ def compressed_allreduce(x: jax.Array, state: CompressionState,
     flat = jnp.zeros((n_pad,), jnp.float32).at[:n].set(x)
 
     # -- worker compression -------------------------------------------- #
-    compensated = flat + state.worker_error
-    sign, scale = _sign_scale(compensated)
-    new_worker_error = compensated - scale * sign.astype(jnp.float32)
+    compensated = ef_compensate(flat, state.worker_error)
+    sign, scale = sign_scale(compensated)
+    new_worker_error = ef_residual(compensated, scale * sign.astype(jnp.float32))
 
     # -- exchange: device d serves chunk d ----------------------------- #
     # [world, chunk] rows = my signs of every chunk → after all_to_all rows
@@ -90,9 +79,10 @@ def compressed_allreduce(x: jax.Array, state: CompressionState,
         theirs.astype(jnp.float32) * scales[:, None], axis=0)     # [c]
 
     # -- server compression of the served chunk ------------------------ #
-    compensated2 = recovered + state.server_error
-    sign2, scale2 = _sign_scale(compensated2)
-    new_server_error = compensated2 - scale2 * sign2.astype(jnp.float32)
+    compensated2 = ef_compensate(recovered, state.server_error)
+    sign2, scale2 = sign_scale(compensated2)
+    new_server_error = ef_residual(compensated2,
+                                   scale2 * sign2.astype(jnp.float32))
 
     # -- gather every server's compressed chunk ------------------------ #
     all_signs = jax.lax.all_gather(sign2, axis_name)              # [w, c] int8

@@ -133,6 +133,7 @@ class DeepSpeedEngine:
         if "param_persistence_threshold" in zc.model_fields_set and zc.stage >= 3:
             min_size = max(min_size, int(zc.param_persistence_threshold))
         self.zero_policy = ZeroShardingPolicy(mesh, zc.stage, min_size=min_size)
+        self._configure_compressed_collectives(zc)
 
         # ---- loss / model adapters ------------------------------------ #
         self._loss_fn = self._make_loss_fn(model)
@@ -701,9 +702,9 @@ class DeepSpeedEngine:
             return loss, grads
 
         gspec = jax.tree.map(lambda _: PartitionSpec("data"), self.state.params)
-        fn = jax.shard_map(local, mesh=self.mesh,
-                           in_specs=(pspec, bspec, PartitionSpec(), PartitionSpec()),
-                           out_specs=(PartitionSpec(), gspec), check_vma=False)
+        fn = mesh_lib.shard_map(local, mesh=self.mesh,
+                                in_specs=(pspec, bspec, PartitionSpec(), PartitionSpec()),
+                                out_specs=(PartitionSpec(), gspec), check_vma=False)
         return jax.jit(fn)
 
     def _build_compress_step(self):
@@ -740,13 +741,238 @@ class DeepSpeedEngine:
 
         gspec = jax.tree.map(lambda _: PartitionSpec("data"), self.state.params)
         rspec = jax.tree.map(lambda _: PartitionSpec(), self.state.params)
-        fn = jax.shard_map(
+        fn = mesh_lib.shard_map(
             compress, mesh=self.mesh,
             in_specs=(gspec, rspec, PartitionSpec("data"), PartitionSpec("data"),
                       PartitionSpec()),
             out_specs=(rspec, PartitionSpec("data"), PartitionSpec("data")),
             check_vma=False)
         return jax.jit(fn, donate_argnums=(0, 2, 3))
+
+    # -- ZeRO++ compressed collectives (qwZ / qgZ / hpZ) ----------------- #
+    def _configure_compressed_collectives(self, zc):
+        """Decide whether the step runs through explicit compressed
+        collectives (comm/compression/) instead of XLA-inserted exact ones.
+
+        Active for stage 3 when any of ``zero_quantized_weights`` /
+        ``zero_quantized_gradients`` / ``zero_hpz_partition_size`` is set
+        and the mesh is pure data-parallel (pipe/expert/seq/tensor all 1 —
+        model-parallel resharding is not covered by the compressed
+        programs).  When active, the ZeRO policy widens to every >1
+        data-parallel axis so the (data, fsdp) = (slow, fast) split matches
+        what qgZ/hpZ key off."""
+        self._cc = None
+        self._cc_step = None
+        self._cc_step_reuse = None
+        self._hpz_secondary = None
+        qw = bool(getattr(zc, "zero_quantized_weights", False))
+        qg = bool(getattr(zc, "zero_quantized_gradients", False))
+        hpz_size = int(getattr(zc, "zero_hpz_partition_size", 1))
+        if not (qw or qg or hpz_size > 1):
+            return
+        if zc.stage < 3:
+            log_dist("compressed collectives: zero_quantized_* / hpz need "
+                     f"stage 3 (got stage {zc.stage}) — ignored", ranks=[0])
+            return
+        if zc.offload_param is not None:
+            log_dist("compressed collectives: offload_param is not combinable "
+                     "with the explicit gather programs — ignored", ranks=[0])
+            return
+        non_dp = [a for a in ("pipe", "expert", "seq", "tensor")
+                  if int(self.mesh.shape[a]) > 1]
+        if non_dp:
+            log_dist(f"compressed collectives: mesh has model-parallel axes "
+                     f"{non_dp} — staying on exact collectives", ranks=[0])
+            return
+        axes = tuple(a for a in ("data", "fsdp") if int(self.mesh.shape[a]) > 1)
+        if not axes:
+            log_dist("compressed collectives: single device — nothing to "
+                     "compress", ranks=[0])
+            return
+        hpz = hpz_size > 1 and len(axes) == 2
+        if hpz_size > 1 and not hpz:
+            log_dist("compressed collectives: zero_hpz_partition_size set but "
+                     "the mesh has no slow/fast axis split (need data>1 and "
+                     "fsdp>1) — hpZ inactive, qwZ/qgZ unaffected", ranks=[0])
+        if axes != self.zero_policy.axes:
+            self.zero_policy = ZeroShardingPolicy(
+                self.mesh, zc.stage, min_size=self.zero_policy.min_size,
+                axes=axes)
+        self._cc = {
+            "axes": axes,
+            "sizes": tuple(int(self.mesh.shape[a]) for a in axes),
+            "qw_bits": int(zc.zero_quantized_weights_bits) if qw else None,
+            "qg_bits": int(zc.zero_quantized_gradients_bits) if qg else None,
+            "block": int(zc.zero_quantization_block_size),
+            "hpz": hpz,
+        }
+        log_dist(f"compressed collectives active over axes {axes}: "
+                 f"qwZ={'int%d' % self._cc['qw_bits'] if qw else 'off'}, "
+                 f"qgZ={'int%d' % self._cc['qg_bits'] if qg else 'off'}, "
+                 f"hpZ={'on' if hpz else 'off'}", ranks=[0])
+
+    def _cc_plan(self):
+        """Per-leaf: which dim the ZeRO policy sharded over the cc axes
+        (None = replicated leaf), in params-leaf order."""
+        from deepspeed_tpu.runtime.zero.partition_parameters import zero_gather_dim
+        axes = self._cc["axes"]
+        return [zero_gather_dim(s.spec, axes)
+                for s in jax.tree.leaves(self.param_shardings)]
+
+    def _cc_byte_table(self, reuse: bool):
+        """op name -> [wire_bytes, logical_bytes] moved per forward call,
+        computed from shapes at build time — appended host-side per executed
+        step (in-program spans fire only at trace time)."""
+        from deepspeed_tpu.comm.compression import qgz, qwz
+        cc = self._cc
+        sizes, world = cc["sizes"], int(np.prod(cc["sizes"]))
+        table = {}
+
+        def add(op, wire, logical):
+            w, l = table.setdefault(op, [0, 0])
+            table[op] = [w + wire, l + logical]
+
+        for p, d in zip(jax.tree.leaves(self.state.params), self._cc_plan()):
+            if d is None:
+                continue
+            n = int(np.prod(p.shape))
+            shard = n // world
+            ag_logical = qwz.logical_bytes(shard, world)
+            if cc["hpz"]:
+                w0, wf = sizes
+                if not reuse:
+                    slow_wire = (qwz.wire_bytes(shard, w0, cc["qw_bits"], cc["block"])
+                                 if cc["qw_bits"] is not None
+                                 else (w0 - 1) * shard * 2)
+                    add("hpz_secondary_gather", slow_wire,
+                        qwz.logical_bytes(shard, w0))
+                add("hpz_fast_all_gather",
+                    qwz.logical_bytes(shard * w0, wf, 2),
+                    qwz.logical_bytes(shard * w0, wf))
+            elif cc["qw_bits"] is not None:
+                add("qwz_all_gather",
+                    qwz.wire_bytes(shard, world, cc["qw_bits"], cc["block"]),
+                    ag_logical)
+            else:
+                add("zero3_all_gather", ag_logical, ag_logical)
+            rs_op = ("qgz_reduce_scatter" if cc["qg_bits"] is not None
+                     else "zero3_reduce_scatter")
+            add(rs_op, qgz.wire_bytes(n, sizes, cc["qg_bits"], cc["block"]),
+                qgz.logical_bytes(n, world))
+        return table
+
+    def _append_cc_bytes(self, reuse: bool):
+        if self.comms_logger is None:
+            return
+        key = "_cc_bytes_reuse" if reuse else "_cc_bytes_refresh"
+        table = getattr(self, key, None)
+        if table is None:
+            table = self._cc_byte_table(reuse)
+            setattr(self, key, table)
+        for op, (wire, logical) in table.items():
+            self.comms_logger.append(op, wire, logical_size=logical)
+
+    def _build_cc_step(self, batch, reuse: bool = False):
+        """The compressed-collective train step: explicit shard_map program
+        that gathers stage-3 shards (qwZ / hpZ), computes local grads, and
+        hierarchically reduce-scatters them (qgZ) back to the ZeRO layout —
+        the standard step's semantics (pmean'd grads in grad_shardings)
+        with topology-aware, optionally quantized wire traffic."""
+        from deepspeed_tpu.comm.compression import hpz as hpz_mod
+        from deepspeed_tpu.comm.compression import qgz, qwz
+        cc = self._cc
+        axes, sizes = cc["axes"], cc["sizes"]
+        group = axes if len(axes) > 1 else axes[0]
+        plan = self._cc_plan()
+        treedef = jax.tree.structure(self.state.params)
+        sec_dtype = jnp.bfloat16
+
+        baxes = mesh_lib.BATCH_AXES
+        bspec = jax.tree.map(
+            lambda x: PartitionSpec(baxes) if getattr(x, "ndim", 0) >= 1
+            else PartitionSpec(), batch)
+        pspecs = jax.tree.map(lambda s: s.spec, self.param_shardings)
+        gspecs = jax.tree.map(lambda s: s.spec, self.grad_shardings)
+
+        def sec_spec(spec, d):
+            if d is None:
+                return PartitionSpec()
+            entries = list(spec) + [None] * (d + 1 - len(spec))
+            entries[d] = axes[-1]       # fast-axis shard only
+            return PartitionSpec(*entries)
+
+        sec_specs = jax.tree.unflatten(treedef, [
+            sec_spec(s, d) for s, d in zip(jax.tree.leaves(pspecs), plan)])
+
+        def reduce_grads(grads):
+            outs = []
+            for g, d in zip(jax.tree.leaves(grads), plan):
+                if d is None:
+                    outs.append(jax.lax.pmean(g, group))
+                else:
+                    outs.append(qgz.hierarchical_reduce_scatter(
+                        g, d, axes, bits=cc["qg_bits"], block_size=cc["block"],
+                        mean=True))
+            return jax.tree.unflatten(treedef, outs)
+
+        def loss_and_grads(full_params, batch, rng, scale):
+            with mesh_lib.manual_sharding():
+                loss, grads = self._value_and_grad(full_params, batch, rng,
+                                                   scale)
+            return jax.lax.pmean(loss, group), reduce_grads(grads)
+
+        if reuse:
+            assert cc["hpz"]
+
+            def body(secs, batch, rng, scale):
+                fulls = []
+                for s, d in zip(jax.tree.leaves(secs), plan):
+                    if d is None:
+                        fulls.append(s.astype(jnp.float32))
+                    else:
+                        fulls.append(hpz_mod.fast_regather(
+                            s, d, axes[1], w_slow=sizes[0]))
+                full = jax.tree.unflatten(treedef, fulls)
+                return loss_and_grads(full, batch, rng, scale)
+
+            fn = mesh_lib.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(sec_specs, bspec, PartitionSpec(), PartitionSpec()),
+                out_specs=(PartitionSpec(), gspecs), check_vma=False)
+            return jax.jit(fn)
+
+        def body(params, batch, rng, scale):
+            fulls, secs = [], []
+            for x, d in zip(jax.tree.leaves(params), plan):
+                if d is None:
+                    fulls.append(x)
+                    secs.append(x.astype(sec_dtype))
+                elif cc["hpz"]:
+                    f, s = hpz_mod.hierarchical_gather(
+                        x, d, axes, quantize_bits=cc["qw_bits"],
+                        block_size=cc["block"], secondary_dtype=sec_dtype)
+                    fulls.append(f)
+                    secs.append(s)
+                elif cc["qw_bits"] is not None:
+                    fulls.append(qwz.quantized_all_gather(
+                        x, axes, dim=d, bits=cc["qw_bits"],
+                        block_size=cc["block"]))
+                else:
+                    fulls.append(jax.lax.all_gather(x, group, axis=d,
+                                                    tiled=True))
+            full = jax.tree.unflatten(treedef, fulls)
+            loss, grads = loss_and_grads(full, batch, rng, scale)
+            if cc["hpz"]:
+                return loss, grads, jax.tree.unflatten(treedef, secs)
+            return loss, grads
+
+        out_specs = ((PartitionSpec(), gspecs, sec_specs) if cc["hpz"]
+                     else (PartitionSpec(), gspecs))
+        fn = mesh_lib.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(pspecs, bspec, PartitionSpec(), PartitionSpec()),
+            out_specs=out_specs, check_vma=False)
+        return jax.jit(fn)
 
     def _maybe_offload(self, shardings, opt_shapes):
         """ZeRO-Offload: place optimizer state in host memory
@@ -789,6 +1015,10 @@ class DeepSpeedEngine:
         self._fused_step = None
         if getattr(self, "_grad_step_local", None) is not None:
             self._grad_step_local = None
+        if getattr(self, "_cc", None) is not None:
+            self._cc_step = None
+            self._cc_step_reuse = None
+            self._hpz_secondary = None
 
     def _eigenvalue_factor(self) -> float:
         """MoQ curvature factor (reference engine.py:2013-2017): every
@@ -1078,7 +1308,33 @@ class DeepSpeedEngine:
         with self._span("fwd", step=self.global_steps,
                         micro_step=self.micro_steps):
             if self._in_training_mode:
-                if self._onebit_active():
+                if getattr(self, "_cc", None) is not None:
+                    # ZeRO++ path: explicit (compressed) gather + hierarchical
+                    # reduce-scatter programs instead of XLA-inserted exact
+                    # collectives.  hpZ reuses the persisted secondary shard
+                    # until the optimizer changes the params.
+                    use_reuse = (self._cc["hpz"]
+                                 and self._hpz_secondary is not None)
+                    if use_reuse:
+                        if self._cc_step_reuse is None:
+                            self._cc_step_reuse = self._build_cc_step(
+                                batch, reuse=True)
+                        loss, grads = self._cc_step_reuse(
+                            self._hpz_secondary, batch, self._next_rng(),
+                            self.state.scaler.scale)
+                    else:
+                        if self._cc_step is None:
+                            self._cc_step = self._build_cc_step(batch)
+                        out = self._cc_step(self.state.params, batch,
+                                            self._next_rng(),
+                                            self.state.scaler.scale)
+                        if self._cc["hpz"]:
+                            loss, grads, self._hpz_secondary = out
+                        else:
+                            loss, grads = out
+                    self._grads_are_local = False
+                    self._append_cc_bytes(reuse=use_reuse)
+                elif self._onebit_active():
                     # post-freeze 1-bit path: gradients stay per-device here
                     # and travel compressed at the gas boundary (step())
                     if self._grad_step_local is None:
@@ -1202,6 +1458,9 @@ class DeepSpeedEngine:
                      self.state.grad_acc, self.state.scaler,
                      self.state.skipped)
             self.state.grad_acc = None
+            # the applied update changed the params: a persisted hpZ
+            # secondary shard is stale from here on
+            self._hpz_secondary = None
             if self.optimizer_swapper is not None:
                 # stream the updated state back to NVMe; device copy released
                 self.optimizer_swapper.swap_out(self.state.opt_state)
@@ -1288,11 +1547,14 @@ class DeepSpeedEngine:
         """One full optimizer step over GAS micro-batches in a single XLA
         program.  ``batch`` leaves must have leading dim [gas, micro, ...],
         or ``data_iter`` yields GAS micro-batches."""
-        if getattr(self, "_onebit_comm", None) is not None:
+        if (getattr(self, "_onebit_comm", None) is not None
+                or getattr(self, "_cc", None) is not None):
             # the fused program reduces gradients exactly, which would hand
             # the post-freeze onebit optimizer raw grads where it expects
             # the compressed momentum — route through the micro-step path,
-            # whose step() performs the compressed exchange
+            # whose step() performs the compressed exchange.  The ZeRO++
+            # compressed path likewise lives in forward()'s explicit
+            # shard_map programs, not in the fused scan.
             self.tput_timer.start()
             losses = []
             for _ in range(self.gradient_accumulation_steps()):

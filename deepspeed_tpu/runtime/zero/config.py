@@ -89,10 +89,41 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     legacy_stage1: bool = False
     round_robin_gradients: bool = False
 
+    # ZeRO++ compressed collectives (arxiv 2306.10209; reference
+    # ``zero/config.py`` gained these keys in v0.10)
+    zero_quantized_weights: bool = False
+    """qwZ: all-gather stage-3 parameter shards as blockwise-quantized codes
+    instead of full-precision elements."""
+    zero_quantized_gradients: bool = False
+    """qgZ: hierarchical gradient reduce-scatter — exact along the fast mesh
+    axis, blockwise-quantized all-to-all along the slow axis."""
+    zero_hpz_partition_size: int = Field(1, ge=1)
+    """hpZ: size of the secondary (intra-host) parameter shard group.  1
+    disables; set to the device count of one host so backward re-gathers
+    never cross the slow inter-host axis."""
+
     # TPU-native additions
     param_shard_min_size: int = Field(2**12, ge=0)
     """Leaves smaller than this stay replicated instead of sharded (analogue
     of ``stage3_param_persistence_threshold`` applied at sharding-spec time)."""
+
+    zero_quantized_weights_bits: int = Field(8)
+    """qwZ code width (4 or 8)."""
+    zero_quantized_gradients_bits: int = Field(8)
+    """qgZ code width (4 or 8)."""
+    zero_quantization_block_size: int = Field(256, ge=2)
+    """Elements per quantization block (one fp32 scale + zero-point each)."""
+
+    @model_validator(mode="after")
+    def quantization_valid(self):
+        for name in ("zero_quantized_weights_bits", "zero_quantized_gradients_bits"):
+            bits = getattr(self, name)
+            if bits not in (4, 8):
+                raise ValueError(f"{name} must be 4 or 8, got {bits}")
+        if self.zero_quantization_block_size % 2:
+            raise ValueError("zero_quantization_block_size must be even "
+                             f"(4-bit packing), got {self.zero_quantization_block_size}")
+        return self
 
     @model_validator(mode="after")
     def overlap_comm_valid(self):

@@ -90,9 +90,81 @@ def materialize(init_fn: Callable[..., Pytree], *args,
     return jax.jit(build, out_shardings=shardings)(*args)
 
 
+def zero_gather_dim(spec: PartitionSpec, axes) -> Optional[int]:
+    """Which dim of a leaf the ZeRO policy sharded over ``axes`` — the spec
+    entry is the tuple itself for multi-axis policies, the bare name for
+    single-axis (see ``zero_partition_spec``).  None → leaf is replicated."""
+    axes = tuple(axes)
+    entry = axes if len(axes) > 1 else axes[0]
+    for d, e in enumerate(spec):
+        if e == entry:
+            return d
+    return None
+
+
+def infer_zero_axes(shardings: Pytree):
+    """Recover the ZeRO axes tuple from materialized param shardings (the
+    first leaf entry built from data/fsdp axes).  Lets ``GatheredParameters``
+    run quantized gathers without the engine handing its policy over."""
+    for s in jax.tree.leaves(shardings):
+        spec = getattr(s, "spec", None)
+        if spec is None:
+            continue
+        for e in spec:
+            if e is None:
+                continue
+            entry = (e,) if isinstance(e, str) else tuple(e)
+            if set(entry) <= {"data", "fsdp"}:
+                return entry
+    return ("fsdp",)
+
+
+def gather_partitioned_params(params: Pytree, shardings: Pytree,
+                              axes=None, quantized: bool = False,
+                              bits: int = 8, block_size: int = 256,
+                              mesh: Optional[Mesh] = None) -> Pytree:
+    """Device-side gather of stage-3 shards into replicated full parameters
+    — the reference's ``_all_gather_params`` (``partition_parameters.py``),
+    here one shard_map program.  ``quantized=True`` is qwZ: shards travel as
+    blockwise int codes (see ``comm/compression/qwz.py``)."""
+    from deepspeed_tpu.comm.compression import qwz
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    from jax import lax
+
+    if mesh is None:
+        mesh = next(s.mesh for s in jax.tree.leaves(shardings)
+                    if hasattr(s, "mesh"))
+    if axes is None:
+        axes = infer_zero_axes(shardings)
+    axes = tuple(axes)
+    specs = jax.tree.map(lambda s: s.spec, shardings,
+                         is_leaf=lambda s: isinstance(s, NamedSharding))
+    plans = jax.tree.map(lambda spec: zero_gather_dim(spec, axes), specs,
+                         is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+    def body(tree):
+        def gather_leaf(x, dim):
+            if dim is None:
+                return x
+            if quantized:
+                return qwz.quantized_all_gather(x, axes, dim=dim, bits=bits,
+                                                block_size=block_size,
+                                                out_dtype=x.dtype)
+            return lax.all_gather(x, axes if len(axes) > 1 else axes[0],
+                                  axis=dim, tiled=True)
+        return jax.tree.map(gather_leaf, tree, plans)
+
+    out_specs = jax.tree.map(lambda _: PartitionSpec(), specs,
+                             is_leaf=lambda s: isinstance(s, PartitionSpec))
+    fn = mesh_lib.shard_map(body, mesh=mesh, in_specs=(specs,),
+                            out_specs=out_specs, check_vma=False)
+    return jax.jit(fn)(params)
+
+
 @contextlib.contextmanager
 def GatheredParameters(params: Pytree, modifier_rank: Optional[int] = None,
-                       fwd_module=None, enabled: bool = True):
+                       fwd_module=None, enabled: bool = True,
+                       quantized: bool = False):
     """Yield a fully-gathered (host) copy of ``params``.
 
     Mirrors the reference API (``partition_parameters.py:1382``): read-only
@@ -104,6 +176,18 @@ def GatheredParameters(params: Pytree, modifier_rank: Optional[int] = None,
     if not enabled:
         yield {"params": params}
         return
+    if quantized:
+        # qwZ on the reassembly itself: shards cross the wire as int codes,
+        # the host copy is the dequantized full tensor (lossy per block
+        # bound — callers opting in accept forward-weight tolerance).
+        leaves = jax.tree.leaves(params)
+        if (leaves and all(isinstance(p, jax.Array)
+                           and isinstance(p.sharding, NamedSharding)
+                           for p in leaves)
+                and any(p.sharding.spec != PartitionSpec() for p in leaves)):
+            shardings = jax.tree.map(lambda p: p.sharding, params)
+            params = gather_partitioned_params(params, shardings,
+                                               quantized=True)
     gathered = jax.device_get(params)
     holder = {"params": jax.tree.map(np.asarray, gathered)}
     yield holder

@@ -46,8 +46,16 @@ class CommsLogger:
         # snapshots (walking comms_dict per step would be O(ops))
         self._total_bytes = 0
         self._total_ops = 0
+        # compressed ops: wire bytes go in comms_dict/_total_bytes like any
+        # op; the pre-compression (logical) volume folds here per op name
+        self._logical = {}
+        self._total_logical = 0
 
-    def append(self, record_name: str, msg_size: int, latency: float = 0.0):
+    def append(self, record_name: str, msg_size: int, latency: float = 0.0,
+               logical_size=None):
+        """Record one op of ``msg_size`` bytes on the wire.  For compressed
+        collectives ``logical_size`` is what the uncompressed op would have
+        moved — the summary derives realized compression ratios from it."""
         if not self.enabled:
             return
         if not self.prof_all and record_name not in self.prof_ops:
@@ -59,6 +67,10 @@ class CommsLogger:
             stats[1].append(latency)
         self._total_bytes += int(msg_size)
         self._total_ops += 1
+        if logical_size is not None:
+            self._logical[record_name] = (self._logical.get(record_name, 0)
+                                          + int(logical_size))
+            self._total_logical += int(logical_size)
         if self.verbose:
             log_dist(f"comm op: {record_name} | msg size: {convert_size(msg_size)}", ranks=[0])
 
@@ -91,7 +103,14 @@ class CommsLogger:
                 "total_bytes": sum(b["total_bytes"] for b in buckets),
                 "count": sum(b["count"] for b in buckets),
             }
+            if record_name in self._logical:
+                logical = self._logical[record_name]
+                wire = ops[record_name]["total_bytes"]
+                ops[record_name]["logical_bytes"] = int(logical)
+                ops[record_name]["compression_ratio"] = (
+                    logical / wire if wire else 0.0)
         return {"ops": ops, "total_bytes": self._total_bytes,
+                "total_logical_bytes": self._total_logical,
                 "total_ops": self._total_ops}
 
     def log_all(self, print_log=True, hub=None, step=None):

@@ -1,0 +1,118 @@
+"""``tools/comm_audit.py`` unit tests — synthetic telemetry JSONL in, JSON
+report + exit code out (the same shell-tool test discipline as
+``tools/verify_checkpoint.py``'s suite)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_comm_audit = _load_tool("comm_audit")
+audit = _comm_audit.audit
+load_last_summary = _comm_audit.load_last_summary
+main = _comm_audit.main
+
+
+def _summary(step=10):
+    return {
+        "kind": "comm_summary", "schema": 1, "step": step,
+        "ops": {
+            "qwz_all_gather": {"count": 20, "total_bytes": 1_000,
+                               "logical_bytes": 4_000,
+                               "compression_ratio": 4.0, "buckets": []},
+            "qgz_reduce_scatter": {"count": 20, "total_bytes": 2_000,
+                                   "logical_bytes": 6_000,
+                                   "compression_ratio": 3.0, "buckets": []},
+            "all_reduce": {"count": 5, "total_bytes": 500, "buckets": []},
+        },
+        "total_bytes": 3_500, "total_logical_bytes": 10_000, "total_ops": 45,
+    }
+
+
+def _write(tmp_path, records, junk=False):
+    p = tmp_path / "run.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "schema", "version": 1}) + "\n")
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+        if junk:
+            f.write('{"kind": "comm_sum')     # torn tail from a crash
+    return str(p)
+
+
+class TestLoad:
+    def test_last_summary_wins(self, tmp_path):
+        p = _write(tmp_path, [_summary(step=1), {"kind": "step", "step": 2},
+                              _summary(step=9)], junk=True)
+        s, err = load_last_summary(p)
+        assert err is None and s["step"] == 9
+
+    def test_missing_file(self, tmp_path):
+        s, err = load_last_summary(str(tmp_path / "nope.jsonl"))
+        assert s is None and "not a file" in err
+
+    def test_no_records(self, tmp_path):
+        p = _write(tmp_path, [{"kind": "step", "step": 1}])
+        s, err = load_last_summary(p)
+        assert s is None and "comm_summary" in err
+
+
+class TestAudit:
+    def test_table_and_aggregate(self):
+        rep, err = audit(_summary())
+        assert err is None
+        assert rep["ops"]["qwz_all_gather"]["compression_ratio"] == 4.0
+        # exact collectives count as ratio 1 (wire IS logical)
+        assert rep["ops"]["all_reduce"]["compression_ratio"] == 1.0
+        assert rep["total_wire_bytes"] == 3_500
+        assert rep["total_logical_bytes"] == 10_500
+        assert rep["aggregate_ratio"] == 3.0
+
+    def test_ops_filter(self):
+        rep, err = audit(_summary(),
+                         ["qwz_all_gather", "qgz_reduce_scatter"])
+        assert err is None and set(rep["ops"]) == {"qwz_all_gather",
+                                                   "qgz_reduce_scatter"}
+        assert rep["aggregate_ratio"] == round(10_000 / 3_000, 4)
+
+    def test_unknown_op_is_an_error(self):
+        rep, err = audit(_summary(), ["qwz_allgather"])   # typo'd name
+        assert rep is None and "not in this run" in err
+
+
+class TestCli:
+    def test_report_and_gate(self, tmp_path, capsys):
+        p = _write(tmp_path, [_summary()])
+        assert main([p, "--min-ratio", "2.5"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["ok"] and rep["aggregate_ratio"] == 3.0
+        assert main([p, "--min-ratio", "3.1"]) == 1
+
+    def test_json_out(self, tmp_path):
+        p = _write(tmp_path, [_summary()])
+        out = tmp_path / "report.json"
+        assert main([p, "--json", str(out)]) == 0
+        rep = json.loads(out.read_text())
+        assert rep["step"] == 10 and "qwz_all_gather" in rep["ops"]
+
+    @pytest.mark.parametrize("argv_tail", [[], ["--ops", "bogus_op"]])
+    def test_usage_errors_exit_2(self, tmp_path, argv_tail, capsys):
+        if argv_tail:
+            p = _write(tmp_path, [_summary()])
+        else:
+            p = _write(tmp_path, [{"kind": "step"}])     # no summaries
+        assert main([p] + argv_tail) == 2
+        assert "error" in json.loads(capsys.readouterr().err)

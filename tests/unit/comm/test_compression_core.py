@@ -1,0 +1,128 @@
+"""Blockwise quantization core (``comm/compression/core.py``).
+
+Round-trip error bounds per bit width / block size, 4-bit packing, wire
+accounting, the error-feedback loop's unbiasedness, and the shared-state
+contract with the 1-bit path (one ``CompressionState``, one compressor)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.compression import core
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("bits", [4, 8])
+    @pytest.mark.parametrize("block", [64, 256])
+    @pytest.mark.parametrize("m", [1024, 1000])   # aligned and ragged tails
+    def test_error_within_per_block_bound(self, bits, block, m):
+        rng = np.random.default_rng(0)
+        x = (rng.standard_normal(m) * rng.uniform(0.1, 10)).astype(np.float32)
+        q = core.quantize_blockwise(x, bits=bits, block_size=block)
+        y = np.asarray(core.dequantize_blockwise(q, m, bits=bits))
+        bound = core.quantization_error_bound(x, bits, block)
+        assert y.shape == x.shape
+        assert (np.abs(y - x) <= bound).all()
+
+    def test_batched_rows_quantize_independently(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 512)).astype(np.float32)
+        q = core.quantize_blockwise(x, bits=8, block_size=128)
+        y = np.asarray(core.dequantize_blockwise(q, 512, bits=8))
+        for r in range(4):
+            qr = core.quantize_blockwise(x[r], bits=8, block_size=128)
+            np.testing.assert_array_equal(
+                y[r], np.asarray(core.dequantize_blockwise(qr, 512, bits=8)))
+
+    def test_constant_block_is_exact(self):
+        x = np.full(256, 3.25, np.float32)
+        q = core.quantize_blockwise(x, bits=8, block_size=256)
+        np.testing.assert_array_equal(
+            np.asarray(core.dequantize_blockwise(q, 256, bits=8)), x)
+
+    def test_edge_padding_does_not_inflate_tail_block(self):
+        # all-positive ragged tail: a zero pad would stretch the tail
+        # block's range down to 0 and blow its step size
+        x = np.linspace(5.0, 6.0, 300).astype(np.float32)
+        q = core.quantize_blockwise(x, bits=8, block_size=256)
+        y = np.asarray(core.dequantize_blockwise(q, 300, bits=8))
+        step = (6.0 - 5.0) / 255
+        assert np.abs(y - x).max() <= step   # not (6.0-0)/255
+
+    def test_jit_safe(self):
+        f = jax.jit(lambda x: core.dequantize_blockwise(
+            core.quantize_blockwise(x, bits=4, block_size=64), 200, bits=4))
+        x = np.random.default_rng(2).standard_normal(200).astype(np.float32)
+        y = np.asarray(f(x))
+        assert (np.abs(y - x)
+                <= core.quantization_error_bound(x, 4, 64)).all()
+
+
+class TestPacking:
+    def test_pack4_unpack4_inverse(self):
+        codes = np.arange(16, dtype=np.uint8).reshape(2, 8) % 16
+        packed = np.asarray(core._pack4(jnp.asarray(codes)))
+        assert packed.shape == (2, 4)
+        np.testing.assert_array_equal(np.asarray(core._unpack4(packed)), codes)
+
+    def test_4bit_payload_is_half(self):
+        x = np.random.default_rng(3).standard_normal(512).astype(np.float32)
+        q8 = core.quantize_blockwise(x, bits=8, block_size=256)
+        q4 = core.quantize_blockwise(x, bits=4, block_size=256)
+        assert q4.data.size == q8.data.size // 2
+        assert q4.data.dtype == np.uint8
+
+
+class TestAccounting:
+    def test_quantized_nbytes(self):
+        # 1000 elems, block 256 → 4 blocks: payload + 4*(scale+zero)
+        assert core.quantized_nbytes(1000, bits=8, block_size=256) == \
+            4 * 256 + 4 * (core.SCALE_BYTES + core.ZERO_BYTES)
+        assert core.quantized_nbytes(1000, bits=4, block_size=256) == \
+            4 * 128 + 4 * (core.SCALE_BYTES + core.ZERO_BYTES)
+
+    def test_int8_beats_fp32_by_3x(self):
+        n = 1 << 20
+        assert 4 * n / core.quantized_nbytes(n, bits=8, block_size=256) > 3.8
+
+
+class TestErrorFeedback:
+    def test_ef_quantize_time_average_converges(self):
+        """Repeated lossy transmission with a carried residual: the mean of
+        the dequantized stream approaches x far beyond one-shot precision
+        (the property the 1-bit and 4-bit paths both rely on)."""
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal(512).astype(np.float32))
+        iters = 64
+
+        def step(res, _):
+            q, res = core.ef_quantize(x, res, bits=4, block_size=128)
+            return res, core.dequantize_blockwise(q, 512, bits=4)
+
+        _, stream = jax.lax.scan(step, jnp.zeros_like(x), None, length=iters)
+        avg_err = np.abs(np.asarray(stream).mean(0) - np.asarray(x)).max()
+        oneshot = core.quantization_error_bound(np.asarray(x), 4, 128).max()
+        assert avg_err < oneshot / 4
+
+    def test_state_shared_with_onebit_path(self):
+        """The 1-bit module's state/compressor ARE the core's (migration
+        contract: one CompressionState shape, one sign/scale)."""
+        from deepspeed_tpu.runtime.comm import compressed
+        assert compressed.CompressionState is core.CompressionState
+        assert compressed.init_compression_state is core.init_compression_state
+        assert compressed.padded_size is core.padded_size
+        assert compressed._sign_scale is core.sign_scale
+
+    def test_sign_scale(self):
+        x = jnp.asarray([3.0, -4.0])
+        sign, scale = core.sign_scale(x)
+        np.testing.assert_array_equal(np.asarray(sign), [1, -1])
+        assert np.isclose(float(scale), 5.0 / np.sqrt(2))
+        assert sign.dtype == jnp.int8
+
+    def test_init_state_shapes(self):
+        we, se = core.init_compression_state(1001, 8)
+        assert we.shape == (1008,) and se.shape == (126,)
+        assert not we.any() and not se.any()

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Offline collective-traffic auditor.
+
+Reads a telemetry JSONL file (``telemetry.jsonl`` from a training run with
+``comms_logger.enabled``) and reports, per collective op, the *logical*
+bytes (what an uncompressed exchange would have moved) against the *wire*
+bytes actually sent — the realized compression ratio of the ZeRO++
+compressed collectives (qwZ/qgZ/hpZ, ``comm/compression/``) and the 1-bit
+allreduce.  The companion of ``tools/verify_checkpoint.py``: shell-side
+forensics over artifacts a run left behind, no jax required.
+
+Usage::
+
+    python tools/comm_audit.py TELEMETRY_JSONL [--ops OP1,OP2]
+                               [--min-ratio X] [--json OUT]
+
+The audit uses the LAST ``comm_summary`` record in the file — the
+CommsLogger fold is cumulative, so the last one covers the whole run.
+Ops recorded without a logical size (exact collectives) count as ratio
+1.0: their wire bytes ARE their logical bytes.  ``--ops`` restricts the
+aggregate (and the gate) to a comma-separated op subset, e.g.
+``--ops qwz_all_gather,qgz_reduce_scatter`` for the ZeRO-3 AG+RS traffic.
+
+Prints a JSON report (also written to ``--json`` if given) and exits 0
+when the aggregate ratio clears ``--min-ratio`` (default 0 = always), 1
+when it does not, 2 on usage errors (unreadable file, no comm_summary
+records, unknown op in --ops).
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_last_summary(path: str):
+    """→ (last comm_summary record, error string or None)."""
+    if not os.path.isfile(path):
+        return None, f"{path}: not a file"
+    last = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue     # torn tail line from a crashed run
+                if isinstance(rec, dict) and rec.get("kind") == "comm_summary":
+                    last = rec
+    except OSError as e:
+        return None, f"unreadable {path}: {e}"
+    if last is None:
+        return None, (f"{path}: no comm_summary records (was the run "
+                      "started with comms_logger.enabled?)")
+    return last, None
+
+
+def audit(summary: dict, ops_filter=None):
+    """Fold a comm_summary record into the per-op audit table.
+
+    → (table dict, error string or None).  ``ops_filter`` (iterable of op
+    names) restricts the table; unknown names are an error so a typo'd
+    gate cannot silently pass on an empty set."""
+    recorded = summary.get("ops", {}) or {}
+    if ops_filter is not None:
+        missing = sorted(set(ops_filter) - set(recorded))
+        if missing:
+            return None, (f"ops not in this run: {', '.join(missing)} "
+                          f"(recorded: {', '.join(sorted(recorded)) or 'none'})")
+        names = [n for n in recorded if n in set(ops_filter)]
+    else:
+        names = list(recorded)
+
+    table = {}
+    tot_wire = tot_logical = 0
+    for name in sorted(names):
+        entry = recorded[name]
+        wire = int(entry.get("total_bytes", 0))
+        logical = int(entry.get("logical_bytes", wire))
+        table[name] = {
+            "count": int(entry.get("count", 0)),
+            "wire_bytes": wire,
+            "logical_bytes": logical,
+            "compression_ratio": round(logical / wire, 4) if wire else 0.0,
+        }
+        tot_wire += wire
+        tot_logical += logical
+    return {
+        "ops": table,
+        "total_wire_bytes": tot_wire,
+        "total_logical_bytes": tot_logical,
+        "aggregate_ratio": round(tot_logical / tot_wire, 4) if tot_wire else 0.0,
+    }, None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Audit logical-vs-wire collective bytes from telemetry JSONL")
+    ap.add_argument("path", help="telemetry JSONL file")
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated op names to audit (default: all)")
+    ap.add_argument("--min-ratio", type=float, default=0.0,
+                    help="fail (exit 1) if the aggregate ratio is below this")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    summary, err = load_last_summary(args.path)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    ops_filter = ([o.strip() for o in args.ops.split(",") if o.strip()]
+                  if args.ops else None)
+    report, err = audit(summary, ops_filter)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    report = {
+        "path": args.path,
+        "step": summary.get("step"),
+        "min_ratio": args.min_ratio,
+        **report,
+    }
+    report["ok"] = report["aggregate_ratio"] >= args.min_ratio
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
