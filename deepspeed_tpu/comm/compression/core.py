@@ -142,6 +142,23 @@ def init_compression_state(n: int, world: int) -> Tuple[np.ndarray, np.ndarray]:
     return (np.zeros((np_,), np.float32), np.zeros((np_ // world,), np.float32))
 
 
+def zeroed_compression_state(state):
+    """Zeros shaped/placed like ``state`` — the coherent reset after a
+    parameter rollback.  Error feedback is a residual of the *trajectory*:
+    once the parameters jump back to an older checkpoint, the carried
+    residuals belong to updates that never happened and re-injecting them
+    corrupts the replayed run (see the stale-EF regression test)."""
+    def z(e):
+        zero = jnp.zeros(e.shape, e.dtype)
+        sharding = getattr(e, "sharding", None)
+        if isinstance(e, jax.Array) and sharding is not None:
+            return jax.device_put(zero, sharding)
+        return np.zeros(e.shape, e.dtype)
+    if isinstance(state, CompressionState):
+        return CompressionState(z(state.worker_error), z(state.server_error))
+    return tuple(z(e) for e in state)
+
+
 def ef_compensate(x, residual):
     """Fold the carried residual into the value about to be compressed."""
     return x + residual

@@ -199,6 +199,12 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "world_size": int(np.prod(list(engine.mesh.shape.values()))),
         "mesh_shape": {k: int(v) for k, v in engine.mesh.shape.items()},
     }
+    # stability sentinel: quarantine ring + ladder counters ride in the
+    # manifest so an auto-rollback (or a relaunch) keeps its quarantine
+    if hasattr(engine, "_stability_state_for_checkpoint"):
+        stability_state = engine._stability_state_for_checkpoint()
+        if stability_state is not None:
+            meta["stability"] = stability_state
     if jax.process_index() == 0:
         atomic_write_json(os.path.join(work_dir, "client_state.json"), meta)
         # recovery script rides along with every checkpoint (reference
@@ -458,6 +464,7 @@ def _load_tag(engine, load_dir: str, tag: str,
 
     meta_path = os.path.join(ckpt_dir, "client_state.json")
     client_state = {}
+    meta = {}
     if os.path.isfile(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
@@ -469,6 +476,10 @@ def _load_tag(engine, load_dir: str, tag: str,
                 and meta.get("lr_scheduler") is not None
                 and hasattr(engine.lr_scheduler, "load_state_dict")):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    if hasattr(engine, "_after_checkpoint_load"):
+        # coherence hook: zero compression error-feedback, re-seed the
+        # stability sentinel, retrace programs that baked a stale LR scale
+        engine._after_checkpoint_load(meta)
     log_dist(f"loaded checkpoint {ckpt_dir} at step {engine.global_steps}", ranks=[0])
     return ckpt_dir, client_state
 

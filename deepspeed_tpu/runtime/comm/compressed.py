@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.comm.compression.core import (  # noqa: F401 — public API
     CompressionState, ef_compensate, ef_residual, init_compression_state,
-    padded_size, sign_scale)
+    padded_size, sign_scale, zeroed_compression_state)
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
 # kept under its historical private name for callers that reached in

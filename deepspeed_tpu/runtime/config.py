@@ -36,6 +36,9 @@ class DeepSpeedFP16Config(DeepSpeedConfigModel):
     loss_scale_window: int = 1000
     hysteresis: int = 2
     min_loss_scale: float = 1
+    # re-arm hysteresis after every good step (reference
+    # ``consecutive_hysteresis``); off → re-arm per completed clean window
+    consecutive_hysteresis: bool = False
     fp16_master_weights_and_grads: bool = False
 
 
@@ -152,6 +155,33 @@ class DeepSpeedFaultToleranceConfig(DeepSpeedConfigModel):
     restart_backoff_max_s: float = 30.0
     restart_jitter: float = 0.2
     stability_window_s: float = 300.0  # uptime that clears restart_count
+
+
+class DeepSpeedStabilityConfig(DeepSpeedConfigModel):
+    """``stability`` block — the training-stability sentinel
+    (``runtime/stability.py``): in-step anomaly detectors + the
+    skip → LR-backoff → rollback recovery ladder.  Off by default; when
+    disabled the engine builds the exact pre-sentinel step program.
+    See README.md § Training stability.
+    """
+    enabled: bool = False
+    # ---- detectors (device half, trace-time constants) ----
+    warmup_steps: int = 20          # clean steps before spike detectors arm
+    ema_alpha: float = 0.02         # EW mean/var decay for loss & grad norm
+    grad_spike_factor: float = 10.0  # grad_norm > factor * EMA → anomaly
+    loss_spike_zscore: float = 8.0  # (loss - EMA) / sigma above this → anomaly
+    scale_collapse_windows: int = 3  # boundaries pinned at min_scale → anomaly
+    # ---- policy ladder (host half) ----
+    skip_anomalous_steps: bool = True  # suppress the update in-program
+    lr_backoff_after: int = 3       # consecutive anomalies before LR backoff
+    lr_backoff_factor: float = 0.5  # multiplies the schedule each backoff
+    max_lr_backoffs: int = 3
+    rollback_after: int = 6         # consecutive anomalies before rollback
+    max_auto_rollbacks: int = 2
+    rollback_load_dir: str = ""     # "" → the last save/load checkpoint dir
+    # ---- batch quarantine ----
+    quarantine: bool = True         # quarantine episode batches at rollback
+    quarantine_ring: int = 64       # fingerprint ring / quarantine-set bound
 
 
 class MeshConfig(DeepSpeedConfigModel):
@@ -341,6 +371,8 @@ class DeepSpeedConfig:
         self.load_universal_checkpoint = self.checkpoint_config.load_universal
         self.fault_tolerance_config = DeepSpeedFaultToleranceConfig(
             **pd.get(C.FAULT_TOLERANCE, {}))
+        self.stability_config = DeepSpeedStabilityConfig(
+            **pd.get(C.STABILITY, {}))
 
         self.eigenvalue_config = EigenvalueConfig(**pd.get(C.EIGENVALUE, {}))
         self.quantize_training_config = QuantizeTrainingConfig(

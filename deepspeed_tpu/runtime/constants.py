@@ -134,6 +134,7 @@ SEQUENCE_PARALLEL = "sequence_parallel"
 #############################################
 ZERO_OPTIMIZATION = "zero_optimization"
 FAULT_TOLERANCE = "fault_tolerance"
+STABILITY = "stability"
 ACTIVATION_CHECKPOINTING = "activation_checkpointing"
 COMMS_LOGGER = "comms_logger"
 TELEMETRY = "telemetry"

@@ -49,8 +49,9 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScalerState, create_loss
                                                     unit_loss_scaler, update_scale)
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.optimizers import get_optimizer
+from deepspeed_tpu.runtime.stability import init_sentinel_state, sentinel_observe
 from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
-from deepspeed_tpu.testing.fault_injection import fault_point
+from deepspeed_tpu.testing.fault_injection import fault_point, numeric_fault
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
                                        FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER,
@@ -73,6 +74,7 @@ class EngineState:
         self.grad_acc = None      # accumulation buffer (None when empty)
         self.scaler: LossScalerState = None
         self.skipped = None       # device i32 counter of skipped (overflow) steps
+        self.sentinel = None      # SentinelState when stability.enabled, else None
 
 
 class DeepSpeedEngine:
@@ -144,9 +146,23 @@ class DeepSpeedEngine:
         self._init_parameters(model, model_parameters)
 
         # ---- optimizer + scheduler ------------------------------------ #
+        # stability LR backoff: set before the optimizer is built so the
+        # schedule wrapper below can close over the scale (trace-time read)
+        self._stability_cfg = self._config.stability_config
+        self._lr_backoff_scale = 1.0
         self.lr_scheduler = None
         self._schedule_fn = None
         self._configure_lr_scheduler(lr_scheduler)
+        if self._stability_cfg.enabled:
+            # the ladder's LR backoff must work even without a scheduler:
+            # lift a static lr into a (scaled) schedule so one retrace
+            # applies the backoff on both paths
+            base_fn = self._schedule_fn
+            if base_fn is None and self.client_optimizer is None:
+                base_lr = float(self._config.optimizer_params.get("lr", 0.0) or 0.0)
+                base_fn = lambda step: jnp.asarray(base_lr, jnp.float32)
+            if base_fn is not None:
+                self._schedule_fn = lambda step: base_fn(step) * self._lr_backoff_scale
         self.optimizer_name_ = (self._config.optimizer_name if self.client_optimizer is None
                                 else "client")
         self._configure_optimizer()
@@ -159,7 +175,8 @@ class DeepSpeedEngine:
                 initial_scale_power=fc.initial_scale_power,
                 loss_scale_window=fc.loss_scale_window,
                 min_loss_scale=fc.min_loss_scale,
-                hysteresis=fc.hysteresis)
+                hysteresis=fc.hysteresis,
+                consecutive_hysteresis=fc.consecutive_hysteresis)
         else:
             self.state.scaler = unit_loss_scaler()
         self.state.scaler = jax.device_put(self.state.scaler,
@@ -213,6 +230,22 @@ class DeepSpeedEngine:
                 batch_size=self.train_batch_size(),
                 steps_per_print=self._config.steps_per_print)
             self.profiler_window = ProfilerWindow.from_config(tcfg)
+
+        # ---- training-stability sentinel -------------------------------- #
+        # None when disabled: the step programs are then built with the
+        # exact pre-sentinel signature and the boundary takes no stability
+        # branch at all (the "enabled=false restores the pre-PR path"
+        # contract).
+        self.stability = None
+        self._step_fps = []           # batch fingerprints of the open window
+        self._last_fp = ""            # fingerprint of the latest micro-batch
+        self._skip_micro = False      # quarantined forward → backward no-ops
+        self._scale_pinned_warned = False
+        if self._stability_cfg.enabled:
+            from deepspeed_tpu.runtime.stability import StabilitySentinel
+            self.stability = StabilitySentinel(self._stability_cfg,
+                                               telemetry=self.telemetry)
+            self.state.sentinel = self._init_sentinel_device_state()
 
         # ---- fault tolerance: preemption-aware shutdown ----------------- #
         # Installed BEFORE the watchdog so the watchdog's SIGTERM chain
@@ -1121,7 +1154,7 @@ class DeepSpeedEngine:
         return acc
 
     def _apply_updates(self, params, opt_state, grads, scaler, skipped,
-                       momentum_mode=False):
+                       momentum_mode=False, sentinel=None, loss=None):
         """One optimizer step: unscale, clip, overflow-gate, update, rescale.
 
         The reference splits this across ``_take_model_step:1924`` and each
@@ -1130,6 +1163,11 @@ class DeepSpeedEngine:
         the already-unscaled compressed momentum — no unscale, no clip
         (clipping a sign-compressed momentum would distort the compensated
         exchange), no overflow gate.
+
+        With the stability sentinel enabled, ``sentinel``/``loss`` thread
+        the detector state through the program: the anomaly code is computed
+        in-program and an anomalous update is suppressed with ``lax.cond``,
+        so the clean path stays sync-free (``runtime/stability.py``).
         """
         params = self._device_view(params, self.param_shardings)
         opt_state = self._device_view(opt_state, self.opt_shardings)
@@ -1162,7 +1200,29 @@ class DeepSpeedEngine:
             params, opt_state, _ = args
             return params, opt_state
 
-        if momentum_mode or not self.fp16_enabled:
+        new_sentinel = None
+        skip = overflow
+        if sentinel is not None:
+            scfg = self._stability_cfg
+            at_min = jnp.logical_and(scaler.dynamic, scaler.scale <= scaler.min_scale)
+            loss_val = (jnp.zeros((), jnp.float32) if loss is None
+                        else jnp.mean(jnp.asarray(loss, jnp.float32)))
+            new_sentinel, code = sentinel_observe(
+                sentinel, loss_val, grad_norm, overflow, at_min,
+                warmup_steps=scfg.warmup_steps,
+                ema_alpha=scfg.ema_alpha,
+                grad_spike_factor=scfg.grad_spike_factor,
+                loss_spike_zscore=scfg.loss_spike_zscore,
+                scale_collapse_windows=scfg.scale_collapse_windows)
+            if scfg.skip_anomalous_steps:
+                skip = jnp.logical_or(overflow, code > 0)
+
+        if sentinel is not None:
+            # anomalies can fire on any precision path, so the gate is
+            # unconditional here; the scaler still reacts to overflow only
+            new_params, new_opt = jax.lax.cond(skip, skip_step, do_step,
+                                               (params, opt_state, grads))
+        elif momentum_mode or not self.fp16_enabled:
             # no dynamic loss scaling → overflow is the constant False; a
             # lax.cond here would force the whole f32 grad tree to
             # materialize at the branch boundary instead of fusing the
@@ -1172,14 +1232,37 @@ class DeepSpeedEngine:
             new_params, new_opt = jax.lax.cond(overflow, skip_step, do_step,
                                                (params, opt_state, grads))
         new_scaler = update_scale(scaler, overflow)
-        new_skipped = skipped + overflow.astype(jnp.int32)
+        new_skipped = skipped + skip.astype(jnp.int32)
         stats = {"grad_norm": grad_norm, "overflow": overflow, "loss_scale": new_scaler.scale}
-        return new_params, new_opt, new_scaler, new_skipped, stats
+        if sentinel is not None:
+            stats["anomaly_code"] = code
+        return new_params, new_opt, new_scaler, new_skipped, new_sentinel, stats
 
     def _build_apply_step(self, momentum_mode=False):
         repl = NamedSharding(self.mesh, PartitionSpec())
+        stats_sh = {"grad_norm": repl, "overflow": repl, "loss_scale": repl}
+
+        if self.stability is not None:
+            # sentinel variant: detector state threaded through (donated),
+            # the mean micro-loss as an extra (non-donated — telemetry still
+            # reads it) input, and the anomaly code in the stats
+            stats_sh = dict(stats_sh, anomaly_code=repl)
+            out_shardings = (self.param_shardings, self.opt_shardings,
+                             jax.tree.map(lambda _: repl, self.state.scaler), repl,
+                             jax.tree.map(lambda _: repl, self.state.sentinel),
+                             stats_sh)
+
+            @partial(jax.jit, donate_argnums=(0, 1, 3, 4, 5), out_shardings=out_shardings)
+            def apply_step_sentinel(params, opt_state, acc, scaler, skipped,
+                                    sentinel, loss):
+                return self._apply_updates(params, opt_state, acc, scaler, skipped,
+                                           momentum_mode=momentum_mode,
+                                           sentinel=sentinel, loss=loss)
+
+            return apply_step_sentinel
+
         out_shardings = (self.param_shardings, self.opt_shardings, jax.tree.map(lambda _: repl, self.state.scaler),
-                         repl, {"grad_norm": repl, "overflow": repl, "loss_scale": repl})
+                         repl, stats_sh)
 
         # acc (arg 2) is NOT donated: every output slot of matching
         # shape/dtype is already aliased by params/opt_state (donated
@@ -1188,8 +1271,10 @@ class DeepSpeedEngine:
         # memory is freed right after the call (state.grad_acc = None)
         @partial(jax.jit, donate_argnums=(0, 1, 3, 4), out_shardings=out_shardings)
         def apply_step(params, opt_state, acc, scaler, skipped):
-            return self._apply_updates(params, opt_state, acc, scaler, skipped,
-                                       momentum_mode=momentum_mode)
+            out = self._apply_updates(params, opt_state, acc, scaler, skipped,
+                                      momentum_mode=momentum_mode)
+            params, opt_state, scaler, skipped, _sentinel, stats = out
+            return params, opt_state, scaler, skipped, stats
 
         return apply_step
 
@@ -1226,8 +1311,8 @@ class DeepSpeedEngine:
                                      params)
                 (grads, loss_sum), _ = jax.lax.scan(
                     micro, (zeros, jnp.zeros((), jnp.float32)), (batches, rngs))
-            new_params, new_opt, new_scaler, new_skipped, stats = self._apply_updates(
-                params, opt_state, grads, scaler, skipped)
+            (new_params, new_opt, new_scaler, new_skipped, _sentinel,
+             stats) = self._apply_updates(params, opt_state, grads, scaler, skipped)
             return (new_params, new_opt, new_scaler, new_skipped), loss_sum / gas, stats
 
         return fused
@@ -1289,6 +1374,28 @@ class DeepSpeedEngine:
             batch = {"__args__": tuple(inputs), "__kwargs__": kwargs}
         else:
             batch = inputs if len(inputs) != 1 else inputs[0]
+        if self.stability is not None and self._in_training_mode:
+            # fingerprint the still-host-resident batch; quarantined
+            # fingerprints (from a previous auto-rollback) are skipped so
+            # the replayed run moves past the offending data
+            fp = self.stability.fingerprint(batch)
+            self._last_fp = fp or ""
+            if fp is not None and self.stability.is_quarantined(fp):
+                if self.telemetry is not None:
+                    self.telemetry.emit(
+                        "batch_quarantined",
+                        {"fp": fp, "phase": "skipped",
+                         "step": self.global_steps,
+                         "micro_step": self.micro_steps},
+                        step=self.global_steps)
+                logger.warning(f"[stability] skipping quarantined batch "
+                               f"{fp} at micro step {self.micro_steps}")
+                self._skip_micro = True
+                self._cached_grads = None
+                self._cached_loss = None
+                return jnp.zeros((), jnp.float32)
+            if fp is not None:
+                self._step_fps.append(fp)
         batch = self._place_batch(batch)
         if (self.optimizer_swapper is not None and self.state.grad_acc is None
                 and self.state.opt_state is None and self._in_training_mode):
@@ -1366,6 +1473,12 @@ class DeepSpeedEngine:
         (reference ``engine.py:1793``; the allreduce/reduce-scatter is
         decided by the gradient shardings, see ZeroShardingPolicy)."""
         assert self._in_training_mode, "backward called in eval mode"
+        if self._skip_micro:
+            # quarantined forward: nothing to accumulate, but the micro
+            # counter must advance so the data pipeline moves past the batch
+            self._skip_micro = False
+            self.micro_steps += 1
+            return loss
         assert self._cached_grads is not None, "backward() must follow forward()"
         self.timers(BACKWARD_MICRO_TIMER).start(sync=False)
         with self._span("bwd", micro_step=self.micro_steps):
@@ -1401,6 +1514,16 @@ class DeepSpeedEngine:
         """Optimizer step at GAS boundaries (reference ``engine.py:1989``)."""
         self.timers(STEP_MICRO_TIMER).start(sync=False)
         if self.is_gradient_accumulation_boundary() and self.state.grad_acc is not None:
+            # value-site fault injection (testing/fault_injection.py): a
+            # near-free no-op without a plan; with one, nan/inf/spike rules
+            # corrupt the boundary values deterministically
+            if self._cached_loss is not None:
+                self._cached_loss = numeric_fault(
+                    "train.loss", self._cached_loss,
+                    step=self.global_steps, fp=self._last_fp)
+            self.state.grad_acc = numeric_fault(
+                "train.grads", self.state.grad_acc,
+                step=self.global_steps, fp=self._last_fp)
             momentum_mode = False
             if getattr(self, "_grads_are_local", False):
                 if self.fp16_enabled:
@@ -1452,11 +1575,20 @@ class DeepSpeedEngine:
                 apply = self._apply_step
             with self._span("step", step=self.global_steps,
                             onebit=momentum_mode):
-                (self.state.params, self.state.opt_state, self.state.scaler,
-                 self.state.skipped, stats) = apply(
-                     self.state.params, self._opt_state_view(),
-                     self.state.grad_acc, self.state.scaler,
-                     self.state.skipped)
+                if self.stability is not None:
+                    loss_in = (self._cached_loss if self._cached_loss is not None
+                               else jnp.zeros((), jnp.float32))
+                    (self.state.params, self.state.opt_state, self.state.scaler,
+                     self.state.skipped, self.state.sentinel, stats) = apply(
+                         self.state.params, self._opt_state_view(),
+                         self.state.grad_acc, self.state.scaler,
+                         self.state.skipped, self.state.sentinel, loss_in)
+                else:
+                    (self.state.params, self.state.opt_state, self.state.scaler,
+                     self.state.skipped, stats) = apply(
+                         self.state.params, self._opt_state_view(),
+                         self.state.grad_acc, self.state.scaler,
+                         self.state.skipped)
             self.state.grad_acc = None
             # the applied update changed the params: a persisted hpZ
             # secondary shard is stale from here on
@@ -1482,9 +1614,28 @@ class DeepSpeedEngine:
         overflow = bool(stats["overflow"]) if self.fp16_enabled else False
         self.global_samples += self.train_batch_size()
         if overflow:
+            scale = float(stats["loss_scale"])
             log_dist(f"fp16 overflow — step skipped, new loss scale "
-                     f"{float(stats['loss_scale'])}", ranks=[0])
+                     f"{scale}", ranks=[0])
+            fc = self._config.fp16_config
+            if fc.loss_scale == 0 and scale <= float(fc.min_loss_scale):
+                # dynamic scale pinned at its floor: every overflow backoff
+                # is a no-op and the run is silently skip-looping — warn
+                # once per pinned episode instead of staying quiet
+                if not self._scale_pinned_warned:
+                    self._scale_pinned_warned = True
+                    logger.warning(
+                        f"dynamic loss scale pinned at min_scale={scale} "
+                        f"and the step still overflows — training is "
+                        f"skip-looping (step {self.global_steps})")
+                    if self.telemetry is not None:
+                        self.telemetry.emit(
+                            "anomaly",
+                            {"cause": "scale_pinned", "loss_scale": scale,
+                             "step": self.global_steps},
+                            step=self.global_steps)
         else:
+            self._scale_pinned_warned = False
             self.global_steps += 1
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
@@ -1539,22 +1690,182 @@ class DeepSpeedEngine:
                 self.profiler_window.step_end(self.global_steps)
             self._report_progress()
         fault_point("train.step", step=self.global_steps)
+        if self.stability is not None:
+            # same seam as the preemption check below: the boundary is the
+            # one place the host may change course between compiled steps
+            self._stability_boundary(stats)
         if (self.preemption_handler is not None
                 and self.preemption_handler.triggered):
             self._preemption_exit()
+
+    # ------------------------------------------------------------------ #
+    # Training-stability sentinel (runtime/stability.py)
+    # ------------------------------------------------------------------ #
+    def _init_sentinel_device_state(self):
+        return jax.device_put(init_sentinel_state(),
+                              NamedSharding(self.mesh, PartitionSpec()))
+
+    def _invalidate_apply_programs(self):
+        """Drop the compiled update programs (they bake the LR schedule in
+        at trace time — an LR backoff or a restored ``lr_scale`` needs a
+        retrace to take effect)."""
+        self._apply_step = None
+        self._fused_step = None
+        if getattr(self, "_apply_step_ob", None) is not None:
+            self._apply_step_ob = None
+
+    def _stability_boundary(self, stats):
+        """Boundary half of the sentinel: buffer this step's stats, judge
+        the previous step's (lagged read — the anomaly code array is
+        already materialized, so the clean path never blocks), and execute
+        whatever ladder action falls out."""
+        fps, self._step_fps = self._step_fps, []
+        action = self.stability.observe(self.global_steps, stats,
+                                        fingerprints=fps)
+        if action is None or action["action"] == "skip":
+            # the skip itself already happened inside the compiled program
+            return
+        with self._span("stability", action=action["action"],
+                        cause=action.get("cause"), step=action.get("step")):
+            if action["action"] == "lr_backoff":
+                self._stability_lr_backoff(action)
+            elif action["action"] == "rollback":
+                self._stability_rollback(action)
+
+    def _stability_lr_backoff(self, action):
+        factor = self._stability_cfg.lr_backoff_factor
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "scale_lr"):
+            scale = self.lr_scheduler.scale_lr(factor)
+        else:
+            self._lr_backoff_scale *= factor
+            scale = self._lr_backoff_scale
+        self._invalidate_apply_programs()
+        self.stability.note_lr_backoff()
+        lr = self.get_lr()[0]
+        logger.warning(f"[stability] LR backoff x{factor} after "
+                       f"{action['consecutive']} consecutive anomalies "
+                       f"(cumulative scale {scale}, lr {lr})")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "lr_backoff",
+                {"step": action["step"], "cause": action["cause"],
+                 "factor": factor, "lr_scale": scale, "lr": lr,
+                 "count": self.stability.lr_backoffs},
+                step=self.global_steps)
+
+    def _stability_rollback(self, action):
+        cfg = self._stability_cfg
+        load_dir = cfg.rollback_load_dir or self._last_ckpt_dir
+        if not load_dir:
+            logger.error("[stability] rollback requested but no checkpoint "
+                         "directory is known (no save_checkpoint yet and "
+                         "stability.rollback_load_dir unset) — ladder stays "
+                         "at skip")
+            self.stability.reset_episode()
+            return
+        from_step = self.global_steps
+        # capture before load_checkpoint: _after_checkpoint_load resets the
+        # episode when it restores the persisted sentinel state
+        candidates = self.stability.episode_fingerprints()
+        path, _client = self.load_checkpoint(load_dir)
+        if path is None:
+            logger.error(f"[stability] auto-rollback found no loadable "
+                         f"verified checkpoint under {load_dir}")
+            self.stability.reset_episode()
+            return
+        added = self.stability.after_rollback(candidates, step=self.global_steps)
+        tag = os.path.basename(str(path).rstrip("/"))
+        logger.warning(f"[stability] auto-rollback: step {from_step} -> "
+                       f"{self.global_steps} (tag {tag}), quarantined "
+                       f"{len(added)} batch fingerprint(s)")
+        if self.telemetry is not None:
+            for fp in added:
+                self.telemetry.emit(
+                    "batch_quarantined",
+                    {"fp": fp, "phase": "quarantined",
+                     "step": self.global_steps},
+                    step=self.global_steps)
+            self.telemetry.emit(
+                "auto_rollback",
+                {"from_step": from_step, "to_step": self.global_steps,
+                 "dir": load_dir, "tag": tag, "cause": action["cause"],
+                 "quarantined": len(added),
+                 "count": self.stability.auto_rollbacks},
+                step=self.global_steps)
+            self.telemetry.flush()
+        # the rolled-back trajectory's cached values are meaningless now
+        self._cached_loss = None
+        self._cached_grads = None
+        self._skip_micro = False
+        self._step_fps = []
+
+    def reset_compression_state(self, reason: str = "load_checkpoint"):
+        """Zero every compression error-feedback buffer + drop the persisted
+        hpZ secondary shard.  Called on every checkpoint load: EF residuals
+        are a property of the parameter *trajectory*, and re-injecting
+        residuals from a discarded trajectory corrupts the replayed run
+        (see the stale-EF regression test).  → list of what was reset."""
+        cleared = []
+        ob = getattr(self, "_onebit_errors", None)
+        if ob is not None:
+            from deepspeed_tpu.comm.compression.core import zeroed_compression_state
+            self._onebit_errors = tuple(zeroed_compression_state(ob))
+            cleared.append("onebit_error_feedback")
+        if getattr(self, "_hpz_secondary", None) is not None:
+            self._hpz_secondary = None
+            cleared.append("hpz_secondary_shard")
+        if cleared:
+            log_dist(f"compression state reset on {reason}: "
+                     f"{', '.join(cleared)}", ranks=[0])
+            if self.telemetry is not None:
+                self.telemetry.emit("ef_reset",
+                                    {"reason": reason, "cleared": cleared},
+                                    step=self.global_steps)
+        return cleared
+
+    def _stability_state_for_checkpoint(self):
+        """Sentinel/quarantine state persisted in the checkpoint manifest
+        (``client_state.json``) — None when stability is disabled."""
+        if self.stability is None:
+            return None
+        sd = self.stability.state_dict()
+        sd["lr_backoff_scale"] = self._lr_backoff_scale
+        return sd
+
+    def _after_checkpoint_load(self, meta):
+        """Checkpoint-load hook (called from ``_load_tag``): make the
+        restored state coherent — EF buffers zeroed, sentinel device state
+        re-initialized (its EMAs described a trajectory that no longer
+        exists), host ladder state restored from the manifest, and the
+        apply programs retraced if the effective LR scale changed."""
+        self.reset_compression_state(reason="load_checkpoint")
+        if self.stability is None:
+            return
+        sd = (meta or {}).get("stability") or {}
+        self.stability.load_state_dict(sd)
+        self._lr_backoff_scale = float(sd.get("lr_backoff_scale", 1.0))
+        self.state.sentinel = self._init_sentinel_device_state()
+        self._step_fps = []
+        self._skip_micro = False
+        # the schedule (scheduler lr_scale and/or the engine backoff scale)
+        # may differ from what the compiled programs baked in
+        self._invalidate_apply_programs()
 
     def train_batch(self, data_iter=None, batch=None):
         """One full optimizer step over GAS micro-batches in a single XLA
         program.  ``batch`` leaves must have leading dim [gas, micro, ...],
         or ``data_iter`` yields GAS micro-batches."""
         if (getattr(self, "_onebit_comm", None) is not None
-                or getattr(self, "_cc", None) is not None):
+                or getattr(self, "_cc", None) is not None
+                or self.stability is not None):
             # the fused program reduces gradients exactly, which would hand
             # the post-freeze onebit optimizer raw grads where it expects
             # the compressed momentum — route through the micro-step path,
             # whose step() performs the compressed exchange.  The ZeRO++
             # compressed path likewise lives in forward()'s explicit
-            # shard_map programs, not in the fused scan.
+            # shard_map programs, not in the fused scan.  The stability
+            # sentinel routes here too: its detectors, fault sites, and
+            # batch fingerprinting live on the micro path.
             self.tput_timer.start()
             losses = []
             for _ in range(self.gradient_accumulation_steps()):

@@ -30,11 +30,15 @@ class LossScalerState(NamedTuple):
     scale_factor: jnp.ndarray
     delayed_shift: jnp.ndarray
     dynamic: jnp.ndarray        # bool: False => static scale, never updates
+    # bool: re-arm hysteresis after every clean step (reference
+    # ``consecutive_hysteresis``); False => re-arm per completed clean window
+    consecutive_hysteresis: jnp.ndarray
 
 
 def create_loss_scaler(*, static_loss_scale: float = 0.0, initial_scale_power: int = 16,
                        loss_scale_window: int = 1000, min_loss_scale: float = 1.0,
-                       hysteresis: int = 2, scale_factor: float = 2.0) -> LossScalerState:
+                       hysteresis: int = 2, scale_factor: float = 2.0,
+                       consecutive_hysteresis: bool = False) -> LossScalerState:
     """``static_loss_scale > 0`` selects a fixed scale (reference
     ``CreateLossScaler``/``loss_scaler.py:202``); 0 selects dynamic scaling
     starting at ``2**initial_scale_power``."""
@@ -49,6 +53,7 @@ def create_loss_scaler(*, static_loss_scale: float = 0.0, initial_scale_power: i
         scale_factor=jnp.asarray(scale_factor, jnp.float32),
         delayed_shift=jnp.asarray(hysteresis, jnp.int32),
         dynamic=jnp.asarray(dynamic, jnp.bool_),
+        consecutive_hysteresis=jnp.asarray(consecutive_hysteresis, jnp.bool_),
     )
 
 
@@ -90,11 +95,25 @@ def update_scale(state: LossScalerState, overflow: jnp.ndarray) -> LossScalerSta
     def on_success(s: LossScalerState) -> LossScalerState:
         grown = (s.good_steps + 1) % s.scale_window == 0
         new_scale = jnp.where(grown, s.scale * s.scale_factor, s.scale)
-        return s._replace(scale=new_scale, good_steps=s.good_steps + 1)
+        # Re-arm hysteresis: a clean window (or, with consecutive_hysteresis,
+        # any clean step) restores the full overflow tolerance — without this
+        # a single early overflow leaves the scaler permanently hair-trigger.
+        rearm = jnp.logical_or(s.consecutive_hysteresis, grown)
+        new_hyst = jnp.where(rearm, jnp.maximum(s.delayed_shift, s.hysteresis),
+                             s.hysteresis)
+        return s._replace(scale=new_scale, good_steps=s.good_steps + 1,
+                          hysteresis=new_hyst)
 
     new_state = jax.lax.cond(overflow, on_overflow, on_success, state)
     # Static scalers never change.
     return jax.tree.map(lambda new, old: jnp.where(state.dynamic, new, old), new_state, state)
+
+
+def at_min_scale(state: LossScalerState) -> jnp.ndarray:
+    """In-program bool: dynamic scale pinned at its floor (every overflow
+    backoff is now a no-op — the skip-loop signal the stability sentinel's
+    scale-collapse detector watches)."""
+    return jnp.logical_and(state.dynamic, state.scale <= state.min_scale)
 
 
 # Object-style veneer for API parity with the reference ------------------- #
@@ -131,7 +150,8 @@ class DynamicLossScaler(LossScalerBase):
             create_loss_scaler(static_loss_scale=0.0,
                                initial_scale_power=int(math.log2(init_scale)),
                                loss_scale_window=scale_window, min_loss_scale=min_scale,
-                               hysteresis=delayed_shift, scale_factor=scale_factor))
+                               hysteresis=delayed_shift, scale_factor=scale_factor,
+                               consecutive_hysteresis=consecutive_hysteresis))
 
 
 def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_args):
@@ -143,6 +163,7 @@ def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_arg
             scale_window=kwargs.get(SCALE_WINDOW, 1000),
             min_scale=kwargs.get(MIN_LOSS_SCALE, 1),
             delayed_shift=kwargs.get(DELAYED_SHIFT, 2),
+            consecutive_hysteresis=kwargs.get(CONSECUTIVE_HYSTERESIS, False),
         )
     loss_scale_value = static_loss_scale if dtype == jnp.float16 else 1.0
     return LossScaler(scale=loss_scale_value)

@@ -49,17 +49,33 @@ DECAY_MOM_RATE = "decay_mom_rate"
 
 
 class _ScheduleBase:
-    """Stateful veneer over a pure schedule function."""
+    """Stateful veneer over a pure schedule function.
+
+    ``lr_scale`` is a host-side multiplier applied on top of the schedule —
+    the stability sentinel's LR-backoff knob (``runtime/stability.py``).
+    It is read at *trace* time: after :meth:`scale_lr` the engine must
+    retrace the programs that baked the schedule in (it invalidates its
+    apply-step cache).  Persisted in ``state_dict`` so a backoff survives
+    checkpoint round-trips.
+    """
 
     def __init__(self, schedule_fn: Callable[[int], float]):
         self._fn = schedule_fn
         self.last_batch_iteration = -1
+        self.lr_scale = 1.0
 
     def schedule_fn(self):
-        return self._fn
+        def scaled(step):
+            return self._fn(step) * self.lr_scale
+        return scaled
+
+    def scale_lr(self, factor: float) -> float:
+        """Multiply the schedule by ``factor`` → the cumulative scale."""
+        self.lr_scale *= float(factor)
+        return self.lr_scale
 
     def get_lr(self) -> List[float]:
-        return [float(self._fn(max(self.last_batch_iteration, 0)))]
+        return [float(self._fn(max(self.last_batch_iteration, 0))) * self.lr_scale]
 
     def get_last_lr(self) -> List[float]:
         return self.get_lr()
@@ -70,10 +86,12 @@ class _ScheduleBase:
         self.last_batch_iteration = last_batch_iteration
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"last_batch_iteration": self.last_batch_iteration}
+        return {"last_batch_iteration": self.last_batch_iteration,
+                "lr_scale": self.lr_scale}
 
     def load_state_dict(self, sd: Dict[str, Any]):
         self.last_batch_iteration = sd["last_batch_iteration"]
+        self.lr_scale = float(sd.get("lr_scale", 1.0))
 
 
 class WarmupLR(_ScheduleBase):

@@ -1,0 +1,370 @@
+"""Training-stability sentinel: in-step anomaly detection + recovery ladder.
+
+Two halves, split the same way as ``fp16/loss_scaler.py``:
+
+* **Device half** — :class:`SentinelState` (a NamedTuple of device scalars)
+  threaded through the compiled apply-step, updated by the pure function
+  :func:`sentinel_observe`.  Detectors (non-finite loss/grads, grad-norm
+  spike vs. an EMA window, loss-spike z-score, loss-scale collapse) run
+  *inside* the jitted program and produce a single int32 cause code; the
+  anomalous update is suppressed in-program with ``lax.cond``.  Nothing on
+  this path forces a host sync.
+
+* **Host half** — :class:`StabilitySentinel`, the policy ladder.  The engine
+  hands it the step stats at each optimizer boundary; the sentinel buffers
+  them and reads the *previous* boundary's cause code (which the prior
+  dispatch has already materialized, so the read does not block the device
+  on the happy path — the same lagged-read discipline as the telemetry
+  windowed drain).  An anomaly therefore surfaces on the host at most one
+  step after it happened, matching the "detected ≤ 1 step later" contract.
+  The ladder escalates: skip (already done in-program) → LR backoff after K
+  consecutive anomalies → auto-rollback to the last verified checkpoint
+  after M, quarantining the fingerprints of the offending batches so the
+  replayed run skips them.
+
+Batch fingerprints are content hashes of host-resident batch leaves
+(:func:`fingerprint_batch`); device-resident batches are not fingerprinted
+(hashing them would force a transfer).  The quarantine set and ladder
+counters round-trip through the checkpoint manifest
+(``state_dict``/``load_state_dict``), with merge semantics chosen for the
+rollback path: quarantine entries union, ``auto_rollbacks`` never moves
+backwards.
+"""
+
+import hashlib
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils import logger
+
+# ---------------------------------------------------------------------------
+# cause codes (int32, 0 = clean).  Order is detection priority: when several
+# detectors fire on one step the lowest code wins.
+# ---------------------------------------------------------------------------
+OK = 0
+NONFINITE_LOSS = 1
+NONFINITE_GRADS = 2
+GRAD_SPIKE = 3
+LOSS_SPIKE = 4
+SCALE_COLLAPSE = 5
+
+CAUSE_NAMES = {
+    OK: "ok",
+    NONFINITE_LOSS: "nonfinite_loss",
+    NONFINITE_GRADS: "nonfinite_grads",
+    GRAD_SPIKE: "grad_norm_spike",
+    LOSS_SPIKE: "loss_spike",
+    SCALE_COLLAPSE: "scale_collapse",
+}
+
+# ladder actions (host side)
+ACTION_SKIP = "skip"
+ACTION_LR_BACKOFF = "lr_backoff"
+ACTION_ROLLBACK = "rollback"
+
+
+class SentinelState(NamedTuple):
+    """Device-resident detector state (all scalars), threaded through the
+    apply-step exactly like :class:`~..fp16.loss_scaler.LossScalerState`."""
+    loss_ema: jnp.ndarray        # EW mean of the loss over clean steps
+    loss_var: jnp.ndarray        # EW variance of the loss (West's update)
+    gnorm_ema: jnp.ndarray       # EW mean of the global grad norm
+    good_steps: jnp.ndarray      # clean steps seen (arms detectors)
+    consecutive: jnp.ndarray     # current anomaly streak
+    anomaly_count: jnp.ndarray   # total anomalies since init
+    last_code: jnp.ndarray       # cause code of the latest observation
+    scale_low_streak: jnp.ndarray  # boundaries with dynamic scale at min
+
+
+def init_sentinel_state() -> SentinelState:
+    """Fresh (unarmed) sentinel state; EMAs seed from the first clean step."""
+    f = lambda v: jnp.asarray(v, jnp.float32)
+    i = lambda v: jnp.asarray(v, jnp.int32)
+    return SentinelState(
+        loss_ema=f(0.0), loss_var=f(0.0), gnorm_ema=f(0.0),
+        good_steps=i(0), consecutive=i(0), anomaly_count=i(0),
+        last_code=i(0), scale_low_streak=i(0))
+
+
+def sentinel_observe(state: SentinelState,
+                     loss: jnp.ndarray,
+                     grad_norm: jnp.ndarray,
+                     overflow: jnp.ndarray,
+                     at_min_scale: jnp.ndarray,
+                     *,
+                     warmup_steps: int,
+                     ema_alpha: float,
+                     grad_spike_factor: float,
+                     loss_spike_zscore: float,
+                     scale_collapse_windows: int) -> Tuple[SentinelState, jnp.ndarray]:
+    """One in-program detector pass → (new state, int32 cause code).
+
+    Pure/jittable; the keyword thresholds are trace-time constants from
+    :class:`DeepSpeedStabilityConfig`.  EMA statistics update only on clean
+    steps (an anomalous loss must not poison the baseline it is judged
+    against), and the spike detectors stay disarmed until ``warmup_steps``
+    clean observations have seeded the window.
+    """
+    loss = jnp.asarray(loss, jnp.float32).reshape(())
+    grad_norm = jnp.asarray(grad_norm, jnp.float32).reshape(())
+    overflow = jnp.asarray(overflow, bool).reshape(())
+    at_min_scale = jnp.asarray(at_min_scale, bool).reshape(())
+    a = jnp.float32(ema_alpha)
+
+    nf_loss = ~jnp.isfinite(loss)
+    nf_grads = overflow | ~jnp.isfinite(grad_norm)
+    armed = state.good_steps >= warmup_steps
+    l_dev = loss - state.loss_ema
+    g_spike = armed & (grad_norm >
+                       grad_spike_factor * jnp.maximum(state.gnorm_ema, 1e-12))
+    l_sigma = jnp.sqrt(jnp.maximum(state.loss_var, 0.0)) + 1e-8
+    # one-sided: a loss *drop* is never an anomaly
+    l_spike = armed & (l_dev > loss_spike_zscore * l_sigma)
+    low_streak = jnp.where(at_min_scale, state.scale_low_streak + 1, 0)
+    collapse = low_streak >= scale_collapse_windows
+
+    code = jnp.where(nf_loss, NONFINITE_LOSS,
+           jnp.where(nf_grads, NONFINITE_GRADS,
+           jnp.where(g_spike, GRAD_SPIKE,
+           jnp.where(l_spike, LOSS_SPIKE,
+           jnp.where(collapse, SCALE_COLLAPSE, OK))))).astype(jnp.int32)
+    anomaly = code > 0
+    clean = ~anomaly
+    first = state.good_steps == 0
+
+    # EW mean/variance (West): only clean steps move the window; the very
+    # first clean step seeds the mean so warmup needs no special init value.
+    new_loss_ema = jnp.where(
+        clean, jnp.where(first, loss, state.loss_ema + a * l_dev),
+        state.loss_ema)
+    new_loss_var = jnp.where(
+        clean, jnp.where(first, 0.0,
+                         (1.0 - a) * (state.loss_var + a * l_dev * l_dev)),
+        state.loss_var)
+    new_gnorm_ema = jnp.where(
+        clean, jnp.where(first, grad_norm,
+                         state.gnorm_ema + a * (grad_norm - state.gnorm_ema)),
+        state.gnorm_ema)
+
+    new_state = SentinelState(
+        loss_ema=new_loss_ema,
+        loss_var=new_loss_var,
+        gnorm_ema=new_gnorm_ema,
+        good_steps=state.good_steps + clean.astype(jnp.int32),
+        consecutive=jnp.where(anomaly, state.consecutive + 1, 0).astype(jnp.int32),
+        anomaly_count=state.anomaly_count + anomaly.astype(jnp.int32),
+        last_code=code,
+        scale_low_streak=low_streak.astype(jnp.int32))
+    return new_state, code
+
+
+# ---------------------------------------------------------------------------
+# batch fingerprinting
+# ---------------------------------------------------------------------------
+
+def fingerprint_batch(batch: Any) -> Optional[str]:
+    """Content hash (blake2b/64-bit hex) of a batch pytree, or ``None``.
+
+    Hashes dtype+shape+bytes of every host-resident leaf.  Returns ``None``
+    when any leaf already lives on device (``jax.Array``): pulling it back
+    would force the very sync the sentinel is designed to avoid, so such
+    batches are simply not quarantine-eligible.
+    """
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        return None
+    h = hashlib.blake2b(digest_size=8)
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array) and not isinstance(leaf, np.ndarray):
+            return None
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+class StabilitySentinel:
+    """Host-side policy ladder over the device sentinel's cause codes.
+
+    ``observe(step, stats, fingerprints)`` buffers the current boundary's
+    stats and *processes the previous one* (lagged read → no blocking sync
+    on the clean path).  It returns ``None`` on a clean previous step, or an
+    action dict ``{"action": skip|lr_backoff|rollback, "step", "code",
+    "cause", "consecutive"}`` for the engine to execute.  The sentinel emits
+    ``anomaly`` telemetry itself; the engine emits the action kinds
+    (``lr_backoff``/``auto_rollback``/``batch_quarantined``) once it has
+    actually performed them.
+    """
+
+    def __init__(self, config, telemetry=None, read_fn=None):
+        self.config = config
+        self.telemetry = telemetry
+        # injectable for the zero-sync unit tests: the only host reads of
+        # device values go through this.
+        self.read_fn = read_fn if read_fn is not None else (
+            lambda v: float(np.asarray(v)))
+        self._pending = None            # last boundary, not yet judged
+        self.consecutive = 0            # host view of the anomaly streak
+        self.lr_backoffs = 0
+        self.auto_rollbacks = 0
+        self.anomalies_total = 0
+        # fingerprints of batches consumed by the current anomaly episode —
+        # the quarantine candidates if the episode escalates to rollback.
+        self._episode_fps: List[str] = []
+        # recent per-step fingerprints, newest last (forensics / manifest)
+        self.ring = deque(maxlen=max(int(config.quarantine_ring), 1))
+        # fp -> global step at which it was quarantined (insertion-ordered)
+        self._quarantined: "OrderedDict[str, int]" = OrderedDict()
+
+    # -- quarantine ------------------------------------------------------- #
+    fingerprint = staticmethod(fingerprint_batch)
+
+    def is_quarantined(self, fp: Optional[str]) -> bool:
+        return bool(fp) and fp in self._quarantined
+
+    def quarantine(self, fps: Sequence[str], step: int) -> List[str]:
+        """Add fingerprints to the quarantine set → the newly added ones."""
+        if not self.config.quarantine:
+            return []
+        added = []
+        for fp in fps:
+            if fp and fp not in self._quarantined:
+                self._quarantined[fp] = int(step)
+                added.append(fp)
+        # bound the set like the ring: oldest entries age out
+        while len(self._quarantined) > self.ring.maxlen:
+            self._quarantined.popitem(last=False)
+        return added
+
+    def quarantined(self) -> Dict[str, int]:
+        return dict(self._quarantined)
+
+    def episode_fingerprints(self) -> List[str]:
+        """Quarantine candidates of the current anomaly episode (deduped)."""
+        out, seen = [], set()
+        for fp in self._episode_fps:
+            if fp not in seen:
+                seen.add(fp)
+                out.append(fp)
+        return out
+
+    # -- the ladder ------------------------------------------------------- #
+    def observe(self, step: int, stats: Dict[str, Any],
+                fingerprints: Sequence[str] = ()) -> Optional[Dict[str, Any]]:
+        fps = [fp for fp in fingerprints if fp]
+        if fps:
+            self.ring.append({"step": int(step), "fps": fps})
+        prev, self._pending = self._pending, {
+            "step": int(step),
+            "code": stats.get("anomaly_code"),
+            "loss": stats.get("loss"),
+            "grad_norm": stats.get("grad_norm"),
+            "loss_scale": stats.get("loss_scale"),
+            "fps": fps,
+        }
+        if prev is None:
+            return None
+        return self._judge(prev, detected_at=int(step))
+
+    def drain(self) -> Optional[Dict[str, Any]]:
+        """Judge the buffered boundary immediately (end of run / tests)."""
+        prev, self._pending = self._pending, None
+        if prev is None:
+            return None
+        return self._judge(prev, detected_at=prev["step"])
+
+    def _judge(self, rec, detected_at: int) -> Optional[Dict[str, Any]]:
+        code = 0 if rec["code"] is None else int(self.read_fn(rec["code"]))
+        if code <= 0:
+            if self.consecutive:
+                self.consecutive = 0
+                self._episode_fps = []
+            return None
+
+        self.consecutive += 1
+        self.anomalies_total += 1
+        self._episode_fps.extend(rec["fps"])
+        cause = CAUSE_NAMES.get(code, f"code_{code}")
+        payload = {
+            "step": rec["step"],
+            "detected_at": detected_at,
+            "code": code,
+            "cause": cause,
+            "consecutive": self.consecutive,
+        }
+        for key in ("loss", "grad_norm", "loss_scale"):
+            if rec[key] is not None:
+                try:
+                    payload[key] = self.read_fn(rec[key])
+                except (TypeError, ValueError):
+                    pass
+        if self.telemetry is not None:
+            self.telemetry.emit("anomaly", dict(payload), step=rec["step"])
+        logger.warning(
+            f"[stability] anomaly at step {rec['step']} ({cause}), "
+            f"streak {self.consecutive}")
+
+        cfg = self.config
+        action = ACTION_SKIP
+        if (cfg.rollback_after > 0 and self.consecutive >= cfg.rollback_after
+                and self.auto_rollbacks < cfg.max_auto_rollbacks):
+            action = ACTION_ROLLBACK
+        elif (cfg.lr_backoff_after > 0
+              and self.consecutive >= cfg.lr_backoff_after
+              and (self.consecutive - cfg.lr_backoff_after)
+              % cfg.lr_backoff_after == 0
+              and self.lr_backoffs < cfg.max_lr_backoffs):
+            action = ACTION_LR_BACKOFF
+        return {"action": action, **payload}
+
+    def note_lr_backoff(self):
+        self.lr_backoffs += 1
+
+    def after_rollback(self, candidate_fps: Sequence[str], step: int) -> List[str]:
+        """Bookkeeping once the engine's checkpoint load succeeded →
+        the newly quarantined fingerprints."""
+        added = self.quarantine(candidate_fps, step)
+        self.auto_rollbacks += 1
+        self.reset_episode()
+        return added
+
+    def reset_episode(self):
+        """Forget the in-flight boundary and the anomaly streak (the arrays
+        it references belong to a trajectory that no longer exists)."""
+        self._pending = None
+        self.consecutive = 0
+        self._episode_fps = []
+
+    # -- checkpoint round-trip ------------------------------------------- #
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "quarantine": [[fp, s] for fp, s in self._quarantined.items()],
+            "ring": list(self.ring),
+            "lr_backoffs": self.lr_backoffs,
+            "auto_rollbacks": self.auto_rollbacks,
+            "anomalies_total": self.anomalies_total,
+        }
+
+    def load_state_dict(self, sd: Optional[Dict[str, Any]]):
+        """Restore from a manifest entry.  Merge semantics serve the
+        rollback path: the quarantine set unions (a rollback must not forget
+        what it just quarantined), and ``auto_rollbacks`` never decreases
+        (the saved value predates the rollback that loaded it)."""
+        sd = sd or {}
+        for fp, s in sd.get("quarantine", []):
+            if fp not in self._quarantined:
+                self._quarantined[str(fp)] = int(s)
+        self.ring.clear()
+        for rec in sd.get("ring", []):
+            self.ring.append(rec)
+        self.lr_backoffs = int(sd.get("lr_backoffs", self.lr_backoffs))
+        self.auto_rollbacks = max(self.auto_rollbacks,
+                                  int(sd.get("auto_rollbacks", 0)))
+        self.anomalies_total = int(sd.get("anomalies_total",
+                                          self.anomalies_total))
+        self.reset_episode()

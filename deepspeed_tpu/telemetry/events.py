@@ -30,10 +30,16 @@ CKPT_SAVED = "ckpt_saved"          # one durable (committed+verified) checkpoint
 CKPT_RETRY = "ckpt_retry"          # transient storage error, save being retried
 CKPT_ROLLBACK = "ckpt_rollback"    # corrupt/torn tag skipped at load
 PREEMPTION = "preemption"          # preemption notice / final-checkpoint exit
+ANOMALY = "anomaly"                # stability sentinel detection (cause code)
+LR_BACKOFF = "lr_backoff"          # recovery ladder scaled the LR schedule
+AUTO_ROLLBACK = "auto_rollback"    # ladder rolled back to a verified tag
+BATCH_QUARANTINED = "batch_quarantined"  # fingerprint quarantined / skipped
+EF_RESET = "ef_reset"              # compression error-feedback zeroed at load
 SCHEMA = "schema"                  # JSONL header record (written by the sink)
 
 KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
          WORKER_EXIT, CKPT_SAVED, CKPT_RETRY, CKPT_ROLLBACK, PREEMPTION,
+         ANOMALY, LR_BACKOFF, AUTO_ROLLBACK, BATCH_QUARANTINED, EF_RESET,
          SCHEMA)
 
 # Every `step` record carries at least these keys once drained.

@@ -16,7 +16,18 @@ site                  fires
 ``train.step``        once per optimizer step (ctx: ``step``)
 ``comm.collective``   per staged collective (ctx: ``op``)
 ``engine.*``          :class:`FaultyCheckpointEngine` wrapper sites
+``train.loss``        *value site* — the cached loss at the step boundary
+``train.grads``       *value site* — accumulated grads at the step boundary
 ====================  =====================================================
+
+The last two are **value sites**: the runtime routes the value itself
+through :func:`numeric_fault`, and the numeric actions (``nan``/``inf``/
+``spike``) corrupt the floating leaves instead of crashing the process —
+host-side injection at the optimizer boundary, so the stability sentinel
+(``runtime/stability.py``) is testable without flaky randomness.  Ctx at
+these sites carries ``step`` and the batch fingerprint ``fp``, so a rule
+can poison exactly one batch's steps: ``{"site": "train.loss", "action":
+"nan", "match": {"fp": "<fingerprint>"}}``.
 
 A *plan* is a JSON list of rules.  Each rule names a site, an action, and
 the 1-based hit count it fires on — so "kill the process the 3rd time a
@@ -40,7 +51,11 @@ from typing import Any, Dict, List, Optional
 
 PLAN_ENV = "DS_FAULT_PLAN"
 
-ACTIONS = ("kill", "raise", "sigterm", "delay", "bitflip", "truncate")
+# numeric actions corrupt a value at a value site instead of crashing;
+# "spike" multiplies by the rule's "factor" (default 1e3)
+NUMERIC_ACTIONS = ("nan", "inf", "spike")
+ACTIONS = ("kill", "raise", "sigterm", "delay", "bitflip",
+           "truncate") + NUMERIC_ACTIONS
 
 
 class FaultInjected(OSError):
@@ -112,6 +127,25 @@ class FaultInjector:
                                  "hit": rule.hits, "ctx": dict(ctx)})
                 self._execute(rule, site, ctx)
 
+    def transform(self, site: str, value, **ctx):
+        """Value-site counterpart of :func:`fire`: route ``value`` through
+        the matching numeric rules (same 1-based hit counters) and return
+        the possibly-corrupted value.  Non-numeric rules at a value site
+        still execute (a ``kill`` at ``train.loss`` is legal)."""
+        for rule in self.rules:
+            if not rule.matches(site, ctx):
+                continue
+            rule.hits += 1
+            if rule.should_fire():
+                self.log.append({"site": site, "action": rule.action,
+                                 "hit": rule.hits, "ctx": dict(ctx)})
+                if rule.action in NUMERIC_ACTIONS:
+                    value = _corrupt_value(value, rule.action,
+                                           float(rule.spec.get("factor", 1e3)))
+                else:
+                    self._execute(rule, site, ctx)
+        return value
+
     # ------------------------------------------------------------------ #
     def _execute(self, rule: FaultRule, site: str, ctx: Dict[str, Any]):
         spec = rule.spec
@@ -129,12 +163,35 @@ class FaultInjector:
         if rule.action == "delay":
             time.sleep(float(spec.get("delay_s", 0.01)))
             return
+        if rule.action in NUMERIC_ACTIONS:
+            # numeric actions only make sense at a value site (numeric_fault)
+            return
         path = _resolve_path(spec.get("path") or ctx.get("path"))
         if rule.action == "bitflip":
             bitflip_file(path, offset=spec.get("offset"))
             return
         if rule.action == "truncate":
             truncate_file(path, size=int(spec.get("size", 0)))
+
+
+def _corrupt_value(value, action: str, factor: float):
+    """Corrupt every floating leaf of a (possibly jax) pytree.  jax is
+    imported lazily — this module must stay loadable without it, and the
+    import only runs when a numeric rule actually fires."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if action == "nan":
+            return jnp.full_like(x, jnp.nan)
+        if action == "inf":
+            return jnp.full_like(x, jnp.inf)
+        return x * jnp.asarray(factor, x.dtype)
+
+    return jax.tree.map(leaf, value)
 
 
 def _resolve_path(path: Optional[str]) -> str:
@@ -221,6 +278,16 @@ def fault_point(site: str, **ctx):
     inj = _injector if _env_checked else get_injector()
     if inj is not None and inj.active:
         inj.fire(site, **ctx)
+
+
+def numeric_fault(site: str, value, **ctx):
+    """Value-site hook: returns ``value`` unchanged (one global read, no
+    copies) unless a plan is installed, in which case matching numeric
+    rules corrupt it (``nan``/``inf``/``spike``) on their scripted hits."""
+    inj = _injector if _env_checked else get_injector()
+    if inj is None or not inj.active:
+        return value
+    return inj.transform(site, value, **ctx)
 
 
 # --------------------------------------------------------------------------- #

@@ -11,13 +11,14 @@ import time
 import numpy as np
 import pytest
 
-from deepspeed_tpu.testing.fault_injection import (ACTIONS, PLAN_ENV,
-                                                   FaultInjected,
+from deepspeed_tpu.testing.fault_injection import (ACTIONS, NUMERIC_ACTIONS,
+                                                   PLAN_ENV, FaultInjected,
                                                    FaultInjector, FaultRule,
                                                    FaultyCheckpointEngine,
                                                    bitflip_file, clear_plan,
                                                    fault_point, get_injector,
                                                    install_plan,
+                                                   numeric_fault,
                                                    truncate_file)
 
 
@@ -156,6 +157,63 @@ class TestGlobalPlan:
         assert get_injector() is not None
         with pytest.raises(FaultInjected):
             fault_point("f.site")
+
+
+class TestNumericFaults:
+    """Value-site corruption (nan/inf/spike) for the train.loss /
+    train.grads sites the stability sentinel watches."""
+
+    def test_numeric_actions_registered(self):
+        assert set(NUMERIC_ACTIONS) == {"nan", "inf", "spike"}
+        for a in NUMERIC_ACTIONS:
+            assert a in ACTIONS
+
+    def test_noop_without_plan(self):
+        x = np.ones((3,), np.float32)
+        assert numeric_fault("train.loss", x) is x        # no copy, no work
+
+    def test_nan_on_scalar_and_pytree(self):
+        install_plan([{"site": "train.grads", "action": "nan"}])
+        out = numeric_fault("train.grads",
+                            {"w": np.ones((2, 2), np.float32),
+                             "step": np.int32(7)})
+        assert np.isnan(np.asarray(out["w"])).all()
+        assert int(out["step"]) == 7                      # ints untouched
+
+    def test_inf_and_spike(self):
+        install_plan([{"site": "a", "action": "inf"},
+                      {"site": "b", "action": "spike", "factor": 100.0}])
+        assert np.isinf(np.asarray(numeric_fault("a", np.float32(3.0))))
+        spiked = numeric_fault("b", np.full((4,), 2.0, np.float32))
+        np.testing.assert_allclose(np.asarray(spiked), 200.0)
+
+    def test_on_hit_counter_is_deterministic(self):
+        inj = FaultInjector([{"site": "train.loss", "action": "nan",
+                              "on_hit": 3}])
+        vals = [inj.transform("train.loss", np.float32(1.0))
+                for _ in range(4)]
+        finite = [bool(np.isfinite(v)) for v in np.asarray(vals)]
+        assert finite == [True, True, False, True]
+
+    def test_match_filters_on_batch_fingerprint(self):
+        inj = FaultInjector([{"site": "train.loss", "action": "nan",
+                              "times": 100, "match": {"fp": "deadbeef"}}])
+        ok = inj.transform("train.loss", np.float32(1.0), fp="cafe0000")
+        assert np.isfinite(ok)
+        assert inj.rules[0].hits == 0          # non-matching hit not counted
+        bad = inj.transform("train.loss", np.float32(1.0), fp="deadbeef")
+        assert np.isnan(np.asarray(bad))
+
+    def test_non_numeric_rule_still_fires_at_value_site(self):
+        inj = FaultInjector([{"site": "train.loss", "action": "raise"}])
+        with pytest.raises(FaultInjected):
+            inj.transform("train.loss", np.float32(1.0))
+
+    def test_numeric_rule_noops_at_plain_site(self):
+        # a nan rule reached via fire() (no value to corrupt) must not blow up
+        inj = FaultInjector([{"site": "train.step", "action": "nan"}])
+        inj.fire("train.step")
+        assert inj.log and inj.log[0]["action"] == "nan"
 
 
 class TestFaultyCheckpointEngine:

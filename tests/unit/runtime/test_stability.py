@@ -1,0 +1,701 @@
+"""Training-stability sentinel tests: the in-program detectors (pure,
+jittable, zero host syncs), the host-side policy ladder (skip → LR
+backoff → rollback), batch-fingerprint quarantine + its manifest
+round-trip, the stale-EF regression the rollback reset exists for, and
+the loss-scaler hardening that feeds the scale-collapse detector.  The
+full subprocess proof (NaN mid-run → detect → rollback → quarantined
+replay → convergence) lives in ``tests/unit/test_stability_e2e.py``."""
+
+import functools
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.config import DeepSpeedStabilityConfig
+from deepspeed_tpu.runtime.stability import (ACTION_LR_BACKOFF,
+                                             ACTION_ROLLBACK, ACTION_SKIP,
+                                             CAUSE_NAMES, GRAD_SPIKE,
+                                             LOSS_SPIKE, NONFINITE_GRADS,
+                                             NONFINITE_LOSS, OK,
+                                             SCALE_COLLAPSE,
+                                             SentinelState, StabilitySentinel,
+                                             fingerprint_batch,
+                                             init_sentinel_state,
+                                             sentinel_observe)
+from deepspeed_tpu.testing.fault_injection import clear_plan, install_plan
+
+HIDDEN = 8
+BATCH = 8
+
+OBSERVE = functools.partial(
+    sentinel_observe, warmup_steps=3, ema_alpha=0.2, grad_spike_factor=10.0,
+    loss_spike_zscore=4.0, scale_collapse_windows=3)
+
+
+def _run(seq, state=None, observe=OBSERVE):
+    """Feed (loss, grad_norm, overflow, at_min) tuples → list of codes."""
+    state = state if state is not None else init_sentinel_state()
+    codes = []
+    for loss, gnorm, ovf, at_min in seq:
+        state, code = observe(state, jnp.float32(loss), jnp.float32(gnorm),
+                              jnp.asarray(ovf), jnp.asarray(at_min))
+        codes.append(int(code))
+    return codes, state
+
+
+def _clean(n, loss=1.0, gnorm=1.0):
+    return [(loss, gnorm, False, False)] * n
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+def _ring_hub():
+    from deepspeed_tpu.telemetry import RingBufferSink, TelemetryHub
+    ring = RingBufferSink(capacity=128)
+    hub = TelemetryHub(sinks=[ring], flush_every=0, sync_fn=lambda: None,
+                       memory_stats_fn=lambda: {})
+    return hub, ring
+
+
+# --------------------------------------------------------------------------- #
+# Device half: the detectors
+# --------------------------------------------------------------------------- #
+class TestSentinelObserve:
+    def test_nonfinite_loss(self):
+        codes, _ = _run(_clean(2) + [(float("nan"), 1.0, False, False)])
+        assert codes == [OK, OK, NONFINITE_LOSS]
+
+    def test_overflow_and_nonfinite_gnorm(self):
+        codes, _ = _run([(1.0, 1.0, True, False),
+                         (1.0, float("inf"), False, False)])
+        assert codes == [NONFINITE_GRADS, NONFINITE_GRADS]
+
+    def test_nonfinite_loss_outranks_overflow(self):
+        codes, _ = _run([(float("nan"), 1.0, True, False)])
+        assert codes == [NONFINITE_LOSS]
+
+    def test_grad_spike_requires_warmup(self):
+        # spike on step 2: the window is not armed yet
+        codes, _ = _run(_clean(1) + [(1.0, 1000.0, False, False)])
+        assert codes == [OK, OK]
+        # armed after warmup_steps clean observations
+        codes, _ = _run(_clean(4) + [(1.0, 1000.0, False, False)])
+        assert codes[-1] == GRAD_SPIKE
+
+    def test_loss_spike_one_sided(self):
+        noisy = [(1.0 + 0.01 * (i % 3), 1.0, False, False) for i in range(10)]
+        codes, state = _run(noisy)
+        assert all(c == OK for c in codes)
+        # a big drop is never an anomaly; a big jump is
+        codes, _ = _run([(0.0, 1.0, False, False)], state=state)
+        assert codes == [OK]
+        codes, _ = _run([(50.0, 1.0, False, False)], state=state)
+        assert codes == [LOSS_SPIKE]
+
+    def test_scale_collapse_needs_streak(self):
+        seq = _clean(4) + [(1.0, 1.0, False, True)] * 2
+        codes, state = _run(seq)
+        assert all(c == OK for c in codes)          # streak 2 < 3 windows
+        codes, state = _run([(1.0, 1.0, False, True)], state=state)
+        assert codes == [SCALE_COLLAPSE]
+        # scale recovering resets the streak
+        codes, _ = _run([(1.0, 1.0, False, False),
+                         (1.0, 1.0, False, True)], state=state)
+        assert codes == [OK, OK]
+
+    def test_anomaly_does_not_poison_ema(self):
+        _, before = _run(_clean(5))
+        _, after = _run([(float("nan"), 123.0, False, False)], state=before)
+        assert float(after.loss_ema) == float(before.loss_ema)
+        assert float(after.gnorm_ema) == float(before.gnorm_ema)
+        assert int(after.good_steps) == int(before.good_steps)
+        assert int(after.consecutive) == 1
+        assert int(after.anomaly_count) == int(before.anomaly_count) + 1
+
+    def test_first_clean_step_seeds_ema(self):
+        _, state = _run([(3.0, 7.0, False, False)])
+        assert float(state.loss_ema) == pytest.approx(3.0)
+        assert float(state.gnorm_ema) == pytest.approx(7.0)
+        assert float(state.loss_var) == 0.0
+
+    def test_consecutive_streak_resets_on_clean(self):
+        _, state = _run(_clean(4) + [(float("nan"), 1.0, False, False)] * 2)
+        assert int(state.consecutive) == 2
+        _, state = _run(_clean(1), state=state)
+        assert int(state.consecutive) == 0
+
+    def test_jittable_under_scan(self):
+        """The detector pass must compile (it runs inside the apply step)."""
+        def body(state, x):
+            loss, gnorm = x
+            state, code = OBSERVE(state, loss, gnorm,
+                                  jnp.asarray(False), jnp.asarray(False))
+            return state, code
+
+        losses = jnp.asarray([1.0, 1.0, 1.0, 1.0, jnp.nan], jnp.float32)
+        gnorms = jnp.ones((5,), jnp.float32)
+        state, codes = jax.jit(lambda s: jax.lax.scan(
+            body, s, (losses, gnorms)))(init_sentinel_state())
+        assert list(np.asarray(codes)) == [OK, OK, OK, OK, NONFINITE_LOSS]
+        assert int(state.anomaly_count) == 1
+
+
+# --------------------------------------------------------------------------- #
+# Batch fingerprints
+# --------------------------------------------------------------------------- #
+class TestFingerprint:
+    def test_deterministic_and_content_sensitive(self):
+        a = (np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.zeros((2,), np.int32))
+        b = (np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.zeros((2,), np.int32))
+        fp_a, fp_b = fingerprint_batch(a), fingerprint_batch(b)
+        assert fp_a == fp_b and len(fp_a) == 16
+        c = (np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.ones((2,), np.int32))
+        assert fingerprint_batch(c) != fp_a
+
+    def test_dtype_and_shape_are_part_of_identity(self):
+        x32 = np.zeros((4,), np.float32)
+        assert fingerprint_batch(x32) != fingerprint_batch(
+            x32.astype(np.float64))
+        assert fingerprint_batch(x32) != fingerprint_batch(
+            x32.reshape(2, 2))
+
+    def test_device_resident_batch_not_fingerprinted(self):
+        # hashing a jax.Array would force the transfer the sentinel avoids
+        assert fingerprint_batch(jnp.zeros((4,))) is None
+        assert fingerprint_batch(
+            (np.zeros((4,), np.float32), jnp.zeros((4,)))) is None
+
+    def test_empty_tree(self):
+        assert fingerprint_batch({}) is None
+
+
+# --------------------------------------------------------------------------- #
+# Host half: the policy ladder
+# --------------------------------------------------------------------------- #
+def _cfg(**kw):
+    return DeepSpeedStabilityConfig(enabled=True, **kw)
+
+
+def _stats(code):
+    return {"anomaly_code": np.int32(code), "grad_norm": np.float32(1.0),
+            "loss_scale": np.float32(1.0)}
+
+
+class TestPolicyLadder:
+    def test_lagged_detection_within_one_step(self):
+        s = StabilitySentinel(_cfg())
+        assert s.observe(1, _stats(0)) is None
+        assert s.observe(2, _stats(NONFINITE_LOSS)) is None   # buffered
+        action = s.observe(3, _stats(0))                      # judged now
+        assert action["action"] == ACTION_SKIP
+        assert action["step"] == 2 and action["detected_at"] == 3
+        assert action["detected_at"] - action["step"] <= 1
+        assert action["cause"] == "nonfinite_loss"
+
+    def test_escalation_skip_backoff_rollback(self):
+        s = StabilitySentinel(_cfg(lr_backoff_after=2, rollback_after=4))
+        actions = []
+        for step in range(1, 8):
+            a = s.observe(step, _stats(NONFINITE_GRADS))
+            actions.append(a["action"] if a else None)
+            # acknowledge the action the way the engine does
+            if a and a["action"] == ACTION_LR_BACKOFF:
+                s.note_lr_backoff()
+            if a and a["action"] == ACTION_ROLLBACK:
+                s.after_rollback([], step=step)      # resets the streak
+        # step 1's code is judged at step 2, etc.  The rollback at streak 4
+        # resets the whole episode — including the buffered boundary, whose
+        # arrays belong to the discarded trajectory — so the ladder restarts
+        # from an empty buffer and then from skip.
+        assert actions == [None, ACTION_SKIP, ACTION_LR_BACKOFF,
+                           ACTION_SKIP, ACTION_ROLLBACK, None, ACTION_SKIP]
+
+    def test_backoff_every_k_until_cap(self):
+        s = StabilitySentinel(_cfg(lr_backoff_after=2, max_lr_backoffs=2,
+                                   rollback_after=0))
+        hits = []
+        for step in range(1, 12):
+            a = s.observe(step, _stats(GRAD_SPIKE))
+            if a and a["action"] == ACTION_LR_BACKOFF:
+                hits.append(a["consecutive"])
+                s.note_lr_backoff()
+        # fires at streak 2 and 4, then the cap holds
+        assert hits == [2, 4]
+
+    def test_rollback_capped(self):
+        s = StabilitySentinel(_cfg(lr_backoff_after=0, rollback_after=1,
+                                   max_auto_rollbacks=1))
+        a = None
+        for step in range(1, 4):
+            a = s.observe(step, _stats(NONFINITE_LOSS)) or a
+        assert a["action"] == ACTION_ROLLBACK
+        s.after_rollback([], step=3)
+        for step in range(4, 7):
+            a = s.observe(step, _stats(NONFINITE_LOSS))
+        assert a["action"] == ACTION_SKIP          # cap reached → no more
+
+    def test_clean_step_resets_streak_and_episode(self):
+        s = StabilitySentinel(_cfg(lr_backoff_after=3))
+        s.observe(1, _stats(NONFINITE_LOSS), fingerprints=["aa"])
+        s.observe(2, _stats(NONFINITE_LOSS), fingerprints=["bb"])
+        assert s.observe(3, _stats(0)) is not None   # judging step 2
+        assert s.consecutive == 2
+        s.observe(4, _stats(NONFINITE_LOSS))         # judges clean step 3
+        assert s.consecutive == 0
+        assert s.episode_fingerprints() == []
+
+    def test_episode_collects_fingerprints_for_quarantine(self):
+        s = StabilitySentinel(_cfg())
+        s.observe(1, _stats(NONFINITE_LOSS), fingerprints=["aa", "bb"])
+        s.observe(2, _stats(NONFINITE_LOSS), fingerprints=["aa"])
+        s.drain()
+        assert s.episode_fingerprints() == ["aa", "bb"]
+        added = s.after_rollback(s.episode_fingerprints(), step=2)
+        assert added == ["aa", "bb"]
+        assert s.is_quarantined("aa") and s.is_quarantined("bb")
+        assert not s.is_quarantined("cc") and not s.is_quarantined(None)
+
+    def test_drain_judges_pending_immediately(self):
+        s = StabilitySentinel(_cfg())
+        assert s.drain() is None
+        s.observe(5, _stats(NONFINITE_LOSS))
+        action = s.drain()
+        assert action["step"] == 5 and action["action"] == ACTION_SKIP
+        assert s.drain() is None
+
+    def test_anomaly_telemetry_emitted(self):
+        hub, ring = _ring_hub()
+        s = StabilitySentinel(_cfg(), telemetry=hub)
+        s.observe(1, _stats(LOSS_SPIKE))
+        s.observe(2, _stats(0))
+        hub.flush()
+        recs = ring.of_kind("anomaly")
+        assert len(recs) == 1
+        assert recs[0]["cause"] == "loss_spike" and recs[0]["step"] == 1
+        assert recs[0]["detected_at"] == 2
+
+    def test_quarantine_respects_config_and_bound(self):
+        s = StabilitySentinel(_cfg(quarantine=False))
+        assert s.quarantine(["aa"], step=1) == []
+        s = StabilitySentinel(_cfg(quarantine_ring=2))
+        s.quarantine(["a1"], 1)
+        s.quarantine(["a2"], 2)
+        s.quarantine(["a3"], 3)
+        assert list(s.quarantined()) == ["a2", "a3"]   # oldest aged out
+
+    def test_state_dict_round_trip_and_merge(self):
+        s = StabilitySentinel(_cfg())
+        s.quarantine(["aa", "bb"], step=4)
+        s.note_lr_backoff()
+        s.auto_rollbacks = 2
+        s.anomalies_total = 5
+        sd = s.state_dict()
+
+        t = StabilitySentinel(_cfg())
+        t.quarantine(["cc"], step=9)     # local entry survives the union
+        t.auto_rollbacks = 3             # never moves backwards
+        t.load_state_dict(sd)
+        assert set(t.quarantined()) == {"aa", "bb", "cc"}
+        assert t.quarantined()["aa"] == 4
+        assert t.lr_backoffs == 1
+        assert t.auto_rollbacks == 3
+        assert t.anomalies_total == 5
+        t.load_state_dict(None)          # tolerated: legacy manifest
+
+    def test_cause_names_cover_all_codes(self):
+        for code in (OK, NONFINITE_LOSS, NONFINITE_GRADS, GRAD_SPIKE,
+                     LOSS_SPIKE, SCALE_COLLAPSE):
+            assert code in CAUSE_NAMES
+
+
+class TestZeroSyncContract:
+    """The sentinel's only host reads of device values go through read_fn.
+    The contract: it never reads the boundary it was just handed — only
+    the previous one, whose arrays the prior dispatch already
+    materialized — and on a clean boundary it reads nothing but the
+    one lagged cause code."""
+
+    def _spy(self):
+        reads = []
+
+        def read_fn(v):
+            reads.append(v)
+            return float(np.asarray(v))
+        return reads, read_fn
+
+    def test_clean_path_reads_only_lagged_code(self):
+        reads, read_fn = self._spy()
+        s = StabilitySentinel(_cfg(), read_fn=read_fn)
+        stats = [_stats(0) for _ in range(4)]
+        for step, st in enumerate(stats, start=1):
+            s.observe(step, st)
+            # never a read of the boundary just handed in
+            assert all(r is not st["anomaly_code"] for r in reads)
+        # exactly one lagged code read per judged boundary, nothing else
+        assert len(reads) == 3
+        assert [r is st_prev["anomaly_code"]
+                for r, st_prev in zip(reads, stats)] == [True] * 3
+
+    def test_anomaly_reads_previous_boundary_only(self):
+        reads, read_fn = self._spy()
+        s = StabilitySentinel(_cfg(), read_fn=read_fn)
+        bad = _stats(NONFINITE_LOSS)
+        nxt = _stats(0)
+        s.observe(1, bad)
+        assert reads == []                       # buffered, untouched
+        s.observe(2, nxt)
+        assert bad["anomaly_code"] in [r for r in reads]
+        assert all(r is not nxt["anomaly_code"] for r in reads)
+        # the extra diagnostic reads are all from the judged (previous) rec
+        for r in reads:
+            assert any(r is v for v in bad.values())
+
+
+# --------------------------------------------------------------------------- #
+# Engine integration (in-process, CPU)
+# --------------------------------------------------------------------------- #
+def _engine(stab=None, extra=None):
+    from deepspeed_tpu.models.simple import SimpleModel
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.key(0))
+    config = {"train_batch_size": BATCH,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+              "checkpoint": {"engine": "local"}}
+    if stab is not None:
+        config["stability"] = stab
+    if extra:
+        config.update(extra)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config)
+    return engine
+
+
+STAB = {"enabled": True, "warmup_steps": 2, "ema_alpha": 0.2,
+        "grad_spike_factor": 1e6, "loss_spike_zscore": 1e6,
+        "lr_backoff_after": 2, "lr_backoff_factor": 0.5,
+        "rollback_after": 3, "max_auto_rollbacks": 2}
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal((BATCH, HIDDEN)).astype(np.float32),
+             np.zeros((BATCH,), np.int32)) for _ in range(n)]
+
+
+def _train(engine, batch):
+    loss = engine.forward(*batch)
+    engine.backward(loss)
+    engine.step()
+    return loss
+
+
+class TestEngineDisabledPath:
+    def test_disabled_is_the_pre_existing_step_path(self):
+        engine = _engine()                       # stability absent entirely
+        assert engine.stability is None
+        assert engine.state.sentinel is None
+        captured = {}
+        orig = engine._advance_step_counters
+        engine._advance_step_counters = \
+            lambda stats: captured.update(stats) or orig(stats)
+        _train(engine, _batches(1)[0])
+        assert "anomaly_code" not in captured    # program shape unchanged
+        assert engine.global_steps == 1
+
+    def test_enabled_threads_sentinel_state(self):
+        engine = _engine(stab=STAB)
+        assert engine.stability is not None
+        assert isinstance(engine.state.sentinel, SentinelState)
+        captured = {}
+        orig = engine._advance_step_counters
+        engine._advance_step_counters = \
+            lambda stats: captured.update(stats) or orig(stats)
+        _train(engine, _batches(1)[0])
+        assert "anomaly_code" in captured
+        assert int(captured["anomaly_code"]) == OK
+
+
+class TestEngineQuarantine:
+    def test_quarantined_batch_is_skipped(self):
+        engine = _engine(stab=STAB)
+        hub, ring = _ring_hub()
+        engine.telemetry = hub
+        engine.stability.telemetry = hub
+        good, bad = _batches(2)
+        fp_bad = engine.stability.fingerprint(bad)
+        engine.stability.quarantine([fp_bad], step=0)
+
+        loss = _train(engine, bad)
+        assert float(np.asarray(loss)) == 0.0
+        assert engine.global_steps == 0          # no grads accumulated
+        assert engine.micro_steps == 1           # but the position advanced
+        hub.flush()
+        recs = ring.of_kind("batch_quarantined")
+        assert recs and recs[0]["phase"] == "skipped"
+        assert recs[0]["fp"] == fp_bad
+
+        loss = _train(engine, good)              # clean batch still trains
+        assert float(np.asarray(loss)) > 0.0
+        assert engine.global_steps == 1
+
+
+class TestEngineLadder:
+    def test_nan_injection_detected_and_lr_backed_off(self):
+        engine = _engine(stab=STAB)
+        hub, ring = _ring_hub()
+        engine.telemetry = hub
+        engine.stability.telemetry = hub
+        batches = _batches(4)
+        lr0 = engine.get_lr()[0]
+        for b in batches[:2]:
+            _train(engine, b)
+        install_plan([{"site": "train.loss", "action": "nan",
+                       "on_hit": 1, "times": 2}])
+        _train(engine, batches[2])
+        _train(engine, batches[3])
+        clear_plan()
+        _train(engine, batches[0])               # judges the 2nd bad step
+        hub.flush()
+        anomalies = ring.of_kind("anomaly")
+        assert len(anomalies) >= 2
+        assert anomalies[0]["cause"] == "nonfinite_loss"
+        assert anomalies[0]["detected_at"] - anomalies[0]["step"] <= 1
+        backs = ring.of_kind("lr_backoff")
+        assert len(backs) == 1
+        assert engine.get_lr()[0] == pytest.approx(lr0 * 0.5)
+
+    def test_rollback_restores_and_quarantines(self, tmp_path):
+        engine = _engine(stab=STAB)
+        hub, ring = _ring_hub()
+        engine.telemetry = hub
+        engine.stability.telemetry = hub
+        batches = _batches(4)
+        poison = (np.full((BATCH, HIDDEN), 0.5, np.float32),
+                  np.zeros((BATCH,), np.int32))
+        fp_poison = engine.stability.fingerprint(poison)
+        for b in batches:
+            _train(engine, b)
+        engine.save_checkpoint(str(tmp_path))
+        install_plan([{"site": "train.loss", "action": "nan", "on_hit": 1,
+                       "times": 10000, "match": {"fp": fp_poison}}])
+        for _ in range(4):                       # streak reaches rollback
+            _train(engine, poison)
+        clear_plan()
+        assert engine.global_steps == 4          # back on the checkpoint
+        assert ring.of_kind("auto_rollback")
+        rec = ring.of_kind("auto_rollback")[0]
+        assert rec["to_step"] == 4 and rec["from_step"] > 4
+        assert fp_poison in engine.stability.quarantined()
+        q = [r for r in ring.of_kind("batch_quarantined")
+             if r["phase"] == "quarantined"]
+        assert q and q[0]["fp"] == fp_poison
+        # replaying the poison batch is now a skip, not an anomaly
+        loss = _train(engine, poison)
+        assert float(np.asarray(loss)) == 0.0
+        _train(engine, batches[0])
+        assert engine.global_steps == 5
+
+    def test_rollback_without_checkpoint_degrades_to_skip(self):
+        engine = _engine(stab={**STAB, "rollback_after": 2})
+        install_plan([{"site": "train.loss", "action": "nan",
+                       "on_hit": 1, "times": 10000}])
+        for b in _batches(4):
+            _train(engine, b)                    # must not raise
+        clear_plan()
+        assert engine.stability.auto_rollbacks == 0
+
+
+class TestManifestRoundTrip:
+    def test_sentinel_state_survives_checkpoint(self, tmp_path):
+        engine = _engine(stab=STAB)
+        engine.stability.quarantine(["feedbeefdeadbeef"], step=3)
+        engine.stability.note_lr_backoff()
+        engine._lr_backoff_scale = 0.25
+        _train(engine, _batches(1)[0])
+        engine.save_checkpoint(str(tmp_path))
+
+        fresh = _engine(stab=STAB)
+        path, _ = fresh.load_checkpoint(str(tmp_path))
+        assert path is not None
+        assert "feedbeefdeadbeef" in fresh.stability.quarantined()
+        assert fresh.stability.quarantined()["feedbeefdeadbeef"] == 3
+        assert fresh.stability.lr_backoffs == 1
+        assert fresh._lr_backoff_scale == 0.25
+        # restored scale must reach the actual lr
+        assert fresh.get_lr()[0] == pytest.approx(1e-2 * 0.25)
+
+    def test_manifest_without_stability_loads_into_enabled_engine(
+            self, tmp_path):
+        # both engines carry a schedule so the optimizer trees match —
+        # enabling stability on a schedule-less config lifts the static lr
+        # into a schedule, which changes the optimizer state tree
+        sched = {"scheduler": {"type": "WarmupLR",
+                               "params": {"warmup_min_lr": 0.0,
+                                          "warmup_max_lr": 1e-2,
+                                          "warmup_num_steps": 2}}}
+        plain = _engine(extra=sched)
+        _train(plain, _batches(1)[0])
+        plain.save_checkpoint(str(tmp_path))
+        fresh = _engine(stab=STAB, extra=sched)
+        path, _ = fresh.load_checkpoint(str(tmp_path))
+        assert path is not None and fresh.global_steps == 1
+        assert fresh.stability.quarantined() == {}
+
+
+# --------------------------------------------------------------------------- #
+# EF reset on rollback (satellite): stale error feedback corrupts replay
+# --------------------------------------------------------------------------- #
+class TestCompressionStateReset:
+    def test_zeroed_compression_state_shapes(self):
+        from deepspeed_tpu.comm.compression.core import (CompressionState,
+                                                         zeroed_compression_state)
+        st = CompressionState(worker_error=jnp.ones((8,), jnp.float32),
+                              server_error=jnp.ones((2,), jnp.float32))
+        z = zeroed_compression_state(st)
+        assert isinstance(z, CompressionState)
+        assert z.worker_error.shape == (8,) and not z.worker_error.any()
+        we, se = zeroed_compression_state(
+            (np.ones((4,), np.float32), np.ones((2,), np.float32)))
+        assert not we.any() and not se.any()
+        assert we.shape == (4,) and se.shape == (2,)
+
+    def test_engine_load_resets_ef_with_telemetry(self, tmp_path):
+        engine = _engine()
+        hub, ring = _ring_hub()
+        engine.telemetry = hub
+        _train(engine, _batches(1)[0])
+        engine.save_checkpoint(str(tmp_path))
+        # fabricate live EF residuals from the about-to-be-discarded
+        # trajectory, as the 1-bit path would carry them
+        engine._onebit_errors = (np.full((16,), 3.0, np.float32),
+                                 np.full((4,), 3.0, np.float32))
+        engine.load_checkpoint(str(tmp_path))
+        we, se = engine._onebit_errors
+        assert not np.asarray(we).any() and not np.asarray(se).any()
+        hub.flush()
+        recs = ring.of_kind("ef_reset")
+        assert recs and recs[0]["reason"] == "load_checkpoint"
+        assert "onebit_error_feedback" in recs[0]["cleared"]
+
+    def test_stale_ef_corrupts_replay_zeroed_does_not(self):
+        """The regression the reset exists for: 1-bit SGD with error
+        feedback on a quadratic.  Roll the parameters back but keep the
+        residual of the discarded (diverged) trajectory → the replay is
+        dragged off-course; zero the residual → the replay matches the
+        fault-free run exactly."""
+        from deepspeed_tpu.comm.compression.core import (ef_compensate,
+                                                         ef_residual,
+                                                         sign_scale)
+        dim, lr = 32, 0.1
+        w_star = jnp.asarray(np.random.default_rng(0).standard_normal(dim),
+                             jnp.float32)
+
+        def sgd(w, e, n, gscale=1.0):
+            for _ in range(n):
+                comp = ef_compensate(gscale * (w - w_star), e)
+                sign, scale = sign_scale(comp)
+                deq = sign.astype(jnp.float32) * scale
+                e = ef_residual(comp, deq)
+                w = w - lr * deq
+            return w, e
+
+        w0 = jnp.zeros((dim,), jnp.float32)
+        e0 = jnp.zeros((dim,), jnp.float32)
+        # converge near the optimum, then a spiked-gradient excursion (the
+        # exact anomaly the sentinel rolls back from) pumps the residual
+        w_ckpt, e_ckpt = sgd(w0, e0, 30)
+        d_ckpt = float(jnp.linalg.norm(w_ckpt - w_star))
+        _, e_stale = sgd(w_ckpt, e_ckpt, 3, gscale=1000.0)
+
+        # rollback restores w_ckpt; the residual must not come along
+        w_stale, _ = sgd(w_ckpt, e_stale, 10)
+        w_zeroed, _ = sgd(w_ckpt, jnp.zeros_like(e_stale), 10)
+        d_stale = float(jnp.linalg.norm(w_stale - w_star))
+        d_zeroed = float(jnp.linalg.norm(w_zeroed - w_star))
+
+        assert d_zeroed < d_ckpt             # zeroed replay keeps converging
+        assert d_stale > 50.0 * d_zeroed     # stale residual wrecks it
+
+
+# --------------------------------------------------------------------------- #
+# Loss-scaler hardening (satellite)
+# --------------------------------------------------------------------------- #
+class TestLossScalerHardening:
+    def _scaler(self, **kw):
+        from deepspeed_tpu.runtime.fp16.loss_scaler import create_loss_scaler
+        return create_loss_scaler(static_loss_scale=0.0,
+                                  initial_scale_power=4, min_loss_scale=1.0,
+                                  loss_scale_window=2, hysteresis=2, **kw)
+
+    def test_hysteresis_rearms_after_clean_window(self):
+        from deepspeed_tpu.runtime.fp16.loss_scaler import update_scale
+        s = self._scaler()
+        s = update_scale(s, jnp.asarray(True))       # eat one overflow
+        assert int(s.hysteresis) == 1
+        s = update_scale(s, jnp.asarray(False))      # window not complete
+        assert int(s.hysteresis) == 1
+        s = update_scale(s, jnp.asarray(False))      # clean window done
+        assert int(s.hysteresis) == 2                # full tolerance back
+
+    def test_consecutive_hysteresis_rearms_every_clean_step(self):
+        from deepspeed_tpu.runtime.fp16.loss_scaler import update_scale
+        s = self._scaler(consecutive_hysteresis=True)
+        s = update_scale(s, jnp.asarray(True))
+        assert int(s.hysteresis) == 1
+        s = update_scale(s, jnp.asarray(False))      # single clean step
+        assert int(s.hysteresis) == 2
+
+    def test_at_min_scale_predicate(self):
+        from deepspeed_tpu.runtime.fp16.loss_scaler import (at_min_scale,
+                                                            create_loss_scaler,
+                                                            update_scale)
+        s = create_loss_scaler(static_loss_scale=0.0, initial_scale_power=1,
+                               min_loss_scale=1.0, hysteresis=1)
+        assert not bool(at_min_scale(s))
+        for _ in range(4):
+            s = update_scale(s, jnp.asarray(True))
+        assert float(s.scale) == 1.0
+        assert bool(at_min_scale(s))
+        # a static scaler is never "pinned"
+        static = create_loss_scaler(static_loss_scale=1.0)
+        assert not bool(at_min_scale(static))
+
+    def test_config_plumbs_consecutive_hysteresis(self):
+        engine = _engine(extra={"fp16": {"enabled": True, "loss_scale": 0,
+                                         "consecutive_hysteresis": True}})
+        assert bool(engine.state.scaler.consecutive_hysteresis)
+
+    def test_pinned_scale_emits_anomaly_once_per_episode(self):
+        engine = _engine(extra={"fp16": {"enabled": True, "loss_scale": 0,
+                                         "min_loss_scale": 1.0}})
+        hub, ring = _ring_hub()
+        engine.telemetry = hub
+        pinned = {"overflow": np.bool_(True), "loss_scale": np.float32(1.0),
+                  "grad_norm": np.float32(1.0)}
+        engine._advance_step_counters(pinned)
+        engine._advance_step_counters(pinned)        # same episode: no dup
+        hub.flush()
+        recs = [r for r in ring.of_kind("anomaly")
+                if r.get("cause") == "scale_pinned"]
+        assert len(recs) == 1
+        clean = {"overflow": np.bool_(False), "loss_scale": np.float32(2.0),
+                 "grad_norm": np.float32(1.0)}
+        engine._advance_step_counters(clean)         # episode ends
+        engine._advance_step_counters(pinned)        # new episode warns again
+        hub.flush()
+        recs = [r for r in ring.of_kind("anomaly")
+                if r.get("cause") == "scale_pinned"]
+        assert len(recs) == 2

@@ -1,0 +1,131 @@
+"""tools/stability_report.py: fold a telemetry JSONL into the stability
+timeline/counts report and gate on rollback count / anomaly rate with
+comm_audit-style exit codes (0 pass, 1 gate fail, 2 usage error)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+@pytest.fixture(scope="module")
+def tool():
+    spec = importlib.util.spec_from_file_location(
+        "stability_report",
+        os.path.join(REPO_ROOT, "tools", "stability_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, records, name="telemetry.jsonl"):
+    p = tmp_path / name
+    with open(p, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(p)
+
+
+def _run_records():
+    recs = [{"kind": "step", "step": i, "loss": 1.0} for i in range(1, 11)]
+    recs += [
+        {"kind": "anomaly", "step": 4, "detected_at": 5,
+         "cause": "nonfinite_loss", "consecutive": 1},
+        {"kind": "anomaly", "step": 5, "detected_at": 6,
+         "cause": "nonfinite_loss", "consecutive": 2},
+        {"kind": "lr_backoff", "step": 6, "cause": "nonfinite_loss",
+         "factor": 0.5, "lr_scale": 0.5},
+        {"kind": "anomaly", "step": 6, "detected_at": 7,
+         "cause": "grad_norm_spike", "consecutive": 3},
+        {"kind": "auto_rollback", "step": 3, "from_step": 7, "to_step": 3,
+         "tag": "global_step3", "cause": "grad_norm_spike"},
+        {"kind": "batch_quarantined", "step": 3, "fp": "aabbccdd00112233",
+         "phase": "quarantined"},
+        {"kind": "batch_quarantined", "step": 5, "fp": "aabbccdd00112233",
+         "phase": "skipped"},
+        {"kind": "ef_reset", "step": 3, "reason": "load_checkpoint",
+         "cleared": ["onebit_error_feedback"]},
+    ]
+    return recs
+
+
+class TestFold:
+    def test_counts_and_causes(self, tool, tmp_path):
+        records, err = tool.load_records(_write(tmp_path, _run_records()))
+        assert err is None
+        rep = tool.fold(records)
+        assert rep["steps"] == 10
+        assert rep["anomalies"] == 3
+        assert rep["anomaly_causes"] == {"nonfinite_loss": 2,
+                                         "grad_norm_spike": 1}
+        assert rep["lr_backoffs"] == 1
+        assert rep["rollbacks"] == 1
+        assert rep["quarantined_fps"] == ["aabbccdd00112233"]
+        assert rep["quarantine_skips"] == 1
+        assert rep["anomaly_rate"] == pytest.approx(0.3)
+        assert rep["counts"]["ef_reset"] == 1
+        kinds = [e["kind"] for e in rep["timeline"]]
+        assert kinds == ["anomaly", "anomaly", "lr_backoff", "anomaly",
+                         "auto_rollback", "batch_quarantined",
+                         "batch_quarantined", "ef_reset"]
+
+    def test_rate_falls_back_to_max_step(self, tool, tmp_path):
+        recs = [{"kind": "anomaly", "step": 4, "cause": "loss_spike"},
+                {"kind": "lr_backoff", "step": 8}]
+        records, _ = tool.load_records(_write(tmp_path, recs))
+        rep = tool.fold(records)
+        assert rep["steps"] == 0
+        assert rep["anomaly_rate"] == pytest.approx(1 / 8)
+
+    def test_torn_tail_line_tolerated(self, tool, tmp_path):
+        p = tmp_path / "torn.jsonl"
+        with open(p, "w") as f:
+            f.write(json.dumps({"kind": "step", "step": 1}) + "\n")
+            f.write('{"kind": "anomaly", "st')       # crashed mid-write
+        records, err = tool.load_records(str(p))
+        assert err is None and len(records) == 1
+
+
+class TestGates:
+    def test_clean_run_exits_zero(self, tool, tmp_path, capsys):
+        recs = [{"kind": "step", "step": i} for i in range(1, 4)]
+        path = _write(tmp_path, recs)
+        rc = tool.main([path, "--max-rollbacks", "0",
+                        "--max-anomaly-rate", "0.0"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["ok"] is True and report["anomalies"] == 0
+
+    def test_gate_failure_exits_one(self, tool, tmp_path, capsys):
+        path = _write(tmp_path, _run_records())
+        assert tool.main([path, "--max-rollbacks", "0"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["gates"]["max_rollbacks"]["ok"] is False
+        assert tool.main([path, "--max-rollbacks", "1",
+                          "--max-anomaly-rate", "0.5"]) == 0
+        capsys.readouterr()
+        assert tool.main([path, "--max-anomaly-rate", "0.1"]) == 1
+
+    def test_no_gates_is_informational_pass(self, tool, tmp_path, capsys):
+        path = _write(tmp_path, _run_records())
+        assert tool.main([path]) == 0
+        assert json.loads(capsys.readouterr().out)["rollbacks"] == 1
+
+    def test_usage_errors_exit_two(self, tool, tmp_path, capsys):
+        assert tool.main([str(tmp_path / "missing.jsonl")]) == 2
+        not_telemetry = tmp_path / "junk.txt"
+        not_telemetry.write_text("hello\nworld\n")
+        assert tool.main([str(not_telemetry)]) == 2
+        err = capsys.readouterr().err
+        assert "no telemetry records" in err
+
+    def test_json_out_written(self, tool, tmp_path, capsys):
+        path = _write(tmp_path, _run_records())
+        out = tmp_path / "report.json"
+        assert tool.main([path, "--json", str(out)]) == 0
+        capsys.readouterr()
+        assert json.loads(out.read_text())["anomalies"] == 3

@@ -84,6 +84,34 @@ def test_fp16_params():
     assert cfg.precision_dtype == jnp.float16
 
 
+def test_stability_config_block():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=8)
+    assert not cfg.stability_config.enabled          # off by default
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "stability": {"enabled": True, "warmup_steps": 5,
+                      "grad_spike_factor": 20.0, "lr_backoff_after": 2,
+                      "lr_backoff_factor": 0.25, "rollback_after": 4,
+                      "max_auto_rollbacks": 1, "quarantine_ring": 16},
+    }, world_size=8)
+    sc = cfg.stability_config
+    assert sc.enabled and sc.warmup_steps == 5
+    assert sc.grad_spike_factor == 20.0
+    assert sc.lr_backoff_after == 2 and sc.lr_backoff_factor == 0.25
+    assert sc.rollback_after == 4 and sc.max_auto_rollbacks == 1
+    assert sc.quarantine and sc.quarantine_ring == 16
+    assert sc.skip_anomalous_steps                   # defaults
+    assert sc.rollback_load_dir == ""
+
+
+def test_fp16_consecutive_hysteresis_key():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "consecutive_hysteresis": True},
+    }, world_size=8)
+    assert cfg.fp16_config.consecutive_hysteresis
+
+
 def test_optimizer_scheduler_blocks():
     cfg = DeepSpeedConfig({
         "train_batch_size": 8,

@@ -1,0 +1,183 @@
+"""End-to-end stability proof, in the test_crash_recovery subprocess
+style: a worker trains with the sentinel enabled while a fault plan
+poisons one specific batch (matched by its content fingerprint) with
+NaN losses.  The run must detect the anomaly within one step, walk the
+ladder (skip → LR backoff → auto-rollback to the last verified
+checkpoint), quarantine the offending batch so the replay skips it, and
+still converge to where a fault-free baseline lands.  The telemetry
+JSONL the run leaves behind is then audited with
+tools/stability_report.py."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.testing.fault_injection import clear_plan
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HIDDEN = 8
+BATCH = 8
+TARGET_STEPS = 12
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+# The worker trains to TARGET_STEPS on a 4-batch cycle, except data
+# positions 6..9 which are one fixed poison batch.  With "faulty" the
+# plan NaNs the loss whenever that batch's fingerprint is seen, so after
+# the rollback to step 4 the quarantine must carry the replay past
+# positions 6..9 for the run to ever finish.
+WORKER = textwrap.dedent("""\
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel
+    from deepspeed_tpu.testing import fault_injection as fi
+
+    save_dir, jsonl, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+    model = SimpleModel(hidden_dim={hidden})
+    params = model.init_params(jax.random.key(0))
+    config = {{
+        "train_batch_size": {batch},
+        "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+        "checkpoint": {{"engine": "local"}},
+        "telemetry": {{"enabled": True, "jsonl_path": jsonl,
+                       "flush_every": 2}},
+        "stability": {{"enabled": True, "warmup_steps": 2,
+                       "ema_alpha": 0.2, "grad_spike_factor": 1e6,
+                       "loss_spike_zscore": 1e6, "lr_backoff_after": 2,
+                       "lr_backoff_factor": 0.5, "rollback_after": 3,
+                       "max_auto_rollbacks": 2}},
+    }}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config)
+
+    rng = np.random.default_rng(0)
+    clean = [(rng.standard_normal(({batch}, {hidden})).astype(np.float32),
+              np.zeros(({batch},), np.int32)) for _ in range(4)]
+    poison = (np.full(({batch}, {hidden}), 0.5, np.float32),
+              np.zeros(({batch},), np.int32))
+    fp_poison = engine.stability.fingerprint(poison)
+    if mode == "faulty":
+        fi.install_plan([{{"site": "train.loss", "action": "nan",
+                           "on_hit": 1, "times": 10000,
+                           "match": {{"fp": fp_poison}}}}])
+
+    def batch_for(pos):
+        return poison if 6 <= pos < 10 else clean[pos % 4]
+
+    last_saved, it, losses = -1, 0, []
+    while engine.global_steps < {target} and it < 80:
+        it += 1
+        x, y = batch_for(engine.micro_steps)
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(np.asarray(loss)))
+        if engine.global_steps != last_saved and engine.global_steps <= 4:
+            engine.save_checkpoint(save_dir)
+            last_saved = engine.global_steps
+    final = sum(losses[-3:]) / 3
+    print("QUARANTINED", len(engine.stability.quarantined()), flush=True)
+    engine.close()
+    print("WORKER_DONE", engine.global_steps, final, flush=True)
+""").format(repo=REPO_ROOT, hidden=HIDDEN, batch=BATCH,
+            target=TARGET_STEPS)
+
+
+def _run_worker(tmp_path, mode):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    save_dir = tmp_path / f"ck_{mode}"
+    jsonl = tmp_path / f"telemetry_{mode}.jsonl"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(script), str(save_dir), str(jsonl), mode],
+        env=env, capture_output=True, text=True, timeout=300)
+    return proc, jsonl
+
+
+def _records(jsonl, kind):
+    out = []
+    with open(jsonl) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def _final_loss(proc):
+    for line in proc.stdout.splitlines():
+        if line.startswith("WORKER_DONE"):
+            _, steps, final = line.split()
+            return int(steps), float(final)
+    raise AssertionError(f"no WORKER_DONE in:\n{proc.stdout}\n{proc.stderr}")
+
+
+@pytest.fixture(scope="module")
+def faulty_run(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("stab_e2e")
+    return tmp_path, *_run_worker(tmp_path, "faulty")
+
+
+class TestStabilityEndToEnd:
+    def test_nan_detect_rollback_quarantine_converge(self, faulty_run):
+        tmp_path, faulty, jsonl = faulty_run
+        assert faulty.returncode == 0, faulty.stderr[-3000:]
+        steps, final_faulty = _final_loss(faulty)
+        assert steps == TARGET_STEPS
+        assert "QUARANTINED 1" in faulty.stdout
+
+        # detection: nonfinite_loss anomalies, each within one step
+        anomalies = _records(jsonl, "anomaly")
+        assert anomalies and all(
+            a["cause"] == "nonfinite_loss" for a in anomalies)
+        assert all(a["detected_at"] - a["step"] <= 1 for a in anomalies)
+
+        # the ladder walked: a backoff at streak 2, one rollback at 3
+        assert len(_records(jsonl, "lr_backoff")) == 1
+        rollbacks = _records(jsonl, "auto_rollback")
+        assert len(rollbacks) == 1
+        assert rollbacks[0]["to_step"] == 4
+        assert rollbacks[0]["from_step"] > rollbacks[0]["to_step"]
+
+        # quarantine round-trip: recorded at rollback, skipped on replay
+        phases = {r["phase"] for r in _records(jsonl, "batch_quarantined")}
+        assert phases == {"quarantined", "skipped"}
+
+        # convergence: the recovered run ends where a fault-free one does
+        baseline, _ = _run_worker(tmp_path, "clean")
+        assert baseline.returncode == 0, baseline.stderr[-3000:]
+        _, final_clean = _final_loss(baseline)
+        assert abs(final_faulty - final_clean) < 0.5
+
+    def test_report_tool_gates_the_run(self, faulty_run):
+        _, faulty, jsonl = faulty_run
+        assert faulty.returncode == 0, faulty.stderr[-3000:]
+        spec = importlib.util.spec_from_file_location(
+            "stability_report",
+            os.path.join(REPO_ROOT, "tools", "stability_report.py"))
+        tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tool)
+        assert tool.main([str(jsonl), "--max-rollbacks", "1",
+                          "--max-anomaly-rate", "0.5"]) == 0
+        assert tool.main([str(jsonl), "--max-rollbacks", "0"]) == 1

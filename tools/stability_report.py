@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Offline training-stability report.
+
+Reads a telemetry JSONL file from a run with the stability sentinel
+enabled (``stability.enabled``, see ``runtime/stability.py``) and folds
+the anomaly/recovery records into a timeline plus per-cause counts — the
+shell-side companion of ``tools/verify_checkpoint.py`` and
+``tools/comm_audit.py``: forensics over artifacts a run left behind, no
+jax required.
+
+Usage::
+
+    python tools/stability_report.py TELEMETRY_JSONL
+        [--max-rollbacks N] [--max-anomaly-rate X] [--json OUT]
+
+Record kinds folded: ``anomaly`` (sentinel detections, incl. the
+``scale_pinned`` loss-scaler warning), ``lr_backoff``, ``auto_rollback``,
+``batch_quarantined`` (both phases: ``quarantined`` at rollback,
+``skipped`` on replay), ``ef_reset``, and ``step`` (to compute the
+anomaly rate).
+
+Prints a JSON report (also written to ``--json`` if given) and exits 0
+when every gate passes, 1 when a gate fails (too many rollbacks, anomaly
+rate too high), 2 on usage errors (unreadable file, not a telemetry
+JSONL).  A clean run — zero anomaly records — is exit 0: absence of
+anomalies is the success case, not a missing-data error.
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+TIMELINE_KINDS = ("anomaly", "lr_backoff", "auto_rollback",
+                  "batch_quarantined", "ef_reset")
+
+
+def load_records(path: str):
+    """→ (records list, error string or None).  Tolerates torn tail lines
+    (a crashed run) but rejects files with no parseable telemetry records
+    at all — those are not telemetry JSONL."""
+    if not os.path.isfile(path):
+        return None, f"{path}: not a file"
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue     # torn tail line from a crashed run
+                if isinstance(rec, dict) and "kind" in rec:
+                    records.append(rec)
+    except OSError as e:
+        return None, f"unreadable {path}: {e}"
+    if not records:
+        return None, f"{path}: no telemetry records (wrong file?)"
+    return records, None
+
+
+def fold(records):
+    """Fold telemetry records into the stability report body."""
+    counts = {k: 0 for k in TIMELINE_KINDS}
+    causes = {}
+    timeline = []
+    quarantined = set()
+    skipped_replays = 0
+    steps = 0
+    max_step = 0
+    for rec in records:
+        kind = rec.get("kind")
+        try:
+            max_step = max(max_step, int(rec.get("step", 0)))
+        except (TypeError, ValueError):
+            pass
+        if kind == "step":
+            steps += 1
+            continue
+        if kind not in TIMELINE_KINDS:
+            continue
+        counts[kind] += 1
+        if kind == "anomaly":
+            cause = str(rec.get("cause", "unknown"))
+            causes[cause] = causes.get(cause, 0) + 1
+        if kind == "batch_quarantined":
+            if rec.get("phase") == "quarantined":
+                quarantined.add(str(rec.get("fp")))
+            elif rec.get("phase") == "skipped":
+                skipped_replays += 1
+        entry = {"kind": kind, "step": rec.get("step")}
+        for key in ("cause", "consecutive", "detected_at", "factor",
+                    "lr_scale", "from_step", "to_step", "tag", "fp",
+                    "phase", "reason", "loss_scale"):
+            if key in rec:
+                entry[key] = rec[key]
+        timeline.append(entry)
+
+    # denominator: prefer counted step records; a run without step records
+    # (telemetry ring too small, or step kind filtered) falls back to the
+    # highest step number any record carries
+    denom = steps or max_step
+    rate = (counts["anomaly"] / denom) if denom else 0.0
+    return {
+        "steps": steps,
+        "counts": counts,
+        "anomaly_causes": causes,
+        "anomalies": counts["anomaly"],
+        "lr_backoffs": counts["lr_backoff"],
+        "rollbacks": counts["auto_rollback"],
+        "quarantined_fps": sorted(quarantined),
+        "quarantine_skips": skipped_replays,
+        "anomaly_rate": round(rate, 6),
+        "timeline": timeline,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Stability-sentinel report over telemetry JSONL")
+    ap.add_argument("path", help="telemetry JSONL file")
+    ap.add_argument("--max-rollbacks", type=int, default=None,
+                    help="fail (exit 1) if auto_rollback count exceeds this")
+    ap.add_argument("--max-anomaly-rate", type=float, default=None,
+                    help="fail (exit 1) if anomalies/steps exceeds this")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    records, err = load_records(args.path)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    report = {"path": args.path, **fold(records)}
+    gates = {}
+    if args.max_rollbacks is not None:
+        gates["max_rollbacks"] = {
+            "limit": args.max_rollbacks,
+            "value": report["rollbacks"],
+            "ok": report["rollbacks"] <= args.max_rollbacks,
+        }
+    if args.max_anomaly_rate is not None:
+        gates["max_anomaly_rate"] = {
+            "limit": args.max_anomaly_rate,
+            "value": report["anomaly_rate"],
+            "ok": report["anomaly_rate"] <= args.max_anomaly_rate,
+        }
+    report["gates"] = gates
+    report["ok"] = all(g["ok"] for g in gates.values())
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
