@@ -26,6 +26,14 @@ parses the final line — and every record persisted to
                 combined, from the same accounting the comms logger uses).
   vs_baseline = value / 4.0 — ZeRO++'s headline 4x collective-volume
   reduction (arxiv 2306.10209 §1).  Skipped below 2 devices.
+* ``serve``: continuous-batching ServingEngine on the toy GPT under
+  synthetic Poisson arrivals (``deepspeed_tpu/serving``).
+  value       = sustained generated tokens/sec over the whole run, valid
+                at the fixed p99 time-to-first-token bound
+                (BENCH_SERVE_P99_TTFT_MS, default 2000) — ``slo_met``
+                says whether p99 TTFT stayed under it.
+  vs_baseline = p99 TTFT bound / measured p99 TTFT (>= 1 means the SLO
+                held with margin).
 
 Timing methodology: the driver may run this through a remote-tunneled TPU
 runtime where ``jax.block_until_ready`` returns before device execution
@@ -34,7 +42,7 @@ dispatch chains of different lengths, each ended by a single scalar fetch
 (the only true sync point), and the per-step cost is the difference — the
 fixed round-trip and dispatch overheads cancel.
 
-Env knobs: BENCH_MODE (all|train|bert|decode|comm), BENCH_MODEL (gpt2|gpt2-medium|
+Env knobs: BENCH_MODE (all|train|bert|decode|comm|serve), BENCH_MODEL (gpt2|gpt2-medium|
 gpt2-large|gpt2-xl | bert-base|bert-large), BENCH_SEQ (default 512 train /
 128 bert), BENCH_MICRO (default 8 train / 32 bert), BENCH_STEPS (default
 16), BENCH_REMAT (1 = activation checkpointing, default 1 — remat with the
@@ -328,6 +336,74 @@ def bench_comm():
     return rec
 
 
+def bench_serve():
+    """Continuous-batching serve rung: Poisson arrivals on the toy GPT
+    through ``ServingEngine``; headline = tokens/s at a fixed p99 TTFT
+    bound.  The offered load (BENCH_SERVE_RATE req/s) is what makes the
+    number meaningful: tokens/s is only quotable while p99 TTFT holds."""
+    import jax
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+    from deepspeed_tpu.serving import DeepSpeedServingConfig, ServingEngine
+
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "32"))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "16"))
+    bound_ms = float(os.environ.get("BENCH_SERVE_P99_TTFT_MS", "2000"))
+    new_max = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "32"))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+
+    cfg = gpt_config("tiny", scan_layers=True)
+    model = GPT(cfg)
+    scfg = DeepSpeedServingConfig(
+        block_size=16, num_blocks=1 + slots * (cfg.n_positions // 16),
+        max_batch_size=slots, prefill_chunk=32,
+        dtype=os.environ.get("BENCH_DTYPE", "bfloat16"))
+    eng = ServingEngine(model, config=scfg)
+
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
+    lens = rng.integers(4, 49, n_req)
+    mnts = rng.integers(max(1, new_max // 2), new_max + 1, n_req)
+    prompts = [rng.integers(1, cfg.vocab_size, size=int(l)).tolist()
+               for l in lens]
+
+    eng.submit(prompts[0][:4], max_new_tokens=2).result()   # compile both traces
+
+    t0 = time.perf_counter()
+    futs, i = [], 0
+    while i < n_req or not all(f.done for f in futs):
+        now = time.perf_counter() - t0
+        while i < n_req and arrivals[i] <= now:
+            futs.append(eng.submit(prompts[i], max_new_tokens=int(mnts[i])))
+            i += 1
+        if not eng.sched.has_work:
+            if i < n_req:
+                time.sleep(min(arrivals[i] - now, 0.01))
+            continue
+        eng.step()
+    elapsed = time.perf_counter() - t0
+
+    ttfts = sorted(f.request.first_token_at - f.request.arrival for f in futs)
+    p99_ms = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))] * 1000.0
+    total_new = sum(len(f.token_ids) for f in futs)
+    rec = {
+        "metric": f"continuous-batching serve tokens/sec (tiny GPT, "
+                  f"{n_req} req Poisson {rate}/s, {slots} slots, "
+                  f"p99 TTFT bound {bound_ms:.0f}ms, "
+                  f"{jax.devices()[0].platform})",
+        "value": round(total_new / elapsed, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(bound_ms / max(p99_ms, 1e-6), 3),
+        "slo_met": bool(p99_ms <= bound_ms),
+        "p99_ttft_ms": round(p99_ms, 1),
+        "mean_ttft_ms": round(1000.0 * sum(ttfts) / len(ttfts), 1),
+        "ttft_bound_ms": bound_ms,
+        "preemptions": eng.sched.preemption_count,
+        "compiled_programs": eng.compiled_programs(),
+    }
+    print(json.dumps(rec))
+    return rec
+
+
 def _detail_path():
     """BENCH_DETAIL_r{N}.json, N = the round the driver will record next
     (one past the newest BENCH_r{N}.json in the repo)."""
@@ -414,7 +490,7 @@ def main():
     if mode != "all":
         # unknown modes raise (a typo must not silently run the full suite)
         {"train": bench_train, "bert": bench_bert, "decode": bench_decode,
-         "comm": bench_comm}[mode]()
+         "comm": bench_comm, "serve": bench_serve}[mode]()
         return
     # default: the full rung set — decode (bf16 + int8 weight-only), BERT
     # MLM, then the headline train line LAST (the driver parses the final
@@ -424,6 +500,7 @@ def main():
                      ("decode_int8", lambda: bench_decode("int8")),
                      ("bert", bench_bert),
                      ("comm", bench_comm),
+                     ("serve", bench_serve),
                      ("train", bench_train)):
         try:
             detail[name] = fn()

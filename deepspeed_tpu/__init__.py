@@ -104,6 +104,13 @@ def init_inference(model=None, config=None, **kwargs):
     return _init(model=model, config=config, **kwargs)
 
 
+def init_serving(model=None, config=None, **kwargs):
+    """Build a continuous-batching ServingEngine (``deepspeed_tpu/serving``)
+    from a ``{"serving": {...}}`` config dict + kwargs."""
+    from deepspeed_tpu.serving.engine import init_serving as _init
+    return _init(model=model, config=config, **kwargs)
+
+
 def add_config_arguments(parser):
     """Augment an argparse parser with DeepSpeed flags (reference
     ``deepspeed/__init__.py:192``)."""
@@ -129,6 +136,9 @@ _LAZY_EXPORTS = {
     "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
     "DeepSpeedInferenceConfig": ("deepspeed_tpu.inference.config",
                                  "DeepSpeedInferenceConfig"),
+    "ServingEngine": ("deepspeed_tpu.serving.engine", "ServingEngine"),
+    "DeepSpeedServingConfig": ("deepspeed_tpu.serving.config",
+                               "DeepSpeedServingConfig"),
     "DeepSpeedConfigError": ("deepspeed_tpu.runtime.config",
                              "DeepSpeedConfigError"),
     "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer",

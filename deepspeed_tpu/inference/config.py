@@ -74,6 +74,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     checkpoint: Optional[Any] = None
     base_dir: str = ""
     seed: int = 0
+    # LRU bound on the per-shape compiled-program caches (generate/forward);
+    # an adversarial mix of shapes evicts oldest instead of growing forever
+    program_cache_size: int = 32
 
     @property
     def jnp_dtype(self):

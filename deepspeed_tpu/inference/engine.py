@@ -21,9 +21,11 @@ and optionally captures CUDA graphs.  TPU-native redesign:
   (``load_checkpoint``) for the same model.
 """
 
+import inspect
 import time
+from collections import OrderedDict
 from contextlib import nullcontext
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -125,9 +127,12 @@ class InferenceEngine:
             log_dist("int8 weight quantization applied to injected blocks "
                      "(reference GroupQuantizer analogue)", ranks=[0])
         self.params = jax.device_put(params, self.param_shardings)
-        self._generate_fns: Dict[Any, Callable] = {}
-        self._forward_fn = None
-        import inspect
+        # per-shape compiled-program caches, LRU-bounded by
+        # config.program_cache_size (an adversarial mix of request shapes
+        # must evict old programs, not grow device memory without limit)
+        self._generate_fns: "OrderedDict[Any, Callable]" = OrderedDict()
+        self._forward_fns: "OrderedDict[bool, Callable]" = OrderedDict()
+        self.program_cache_evictions = 0
         self._bucketed_generate = (
             hasattr(self.module, "generate")
             and "prompt_len" in inspect.signature(
@@ -172,24 +177,48 @@ class InferenceEngine:
         self.telemetry.emit("inference_request", rec, step=self._request_count)
         return out
 
+    # ---- LRU program-cache plumbing ---------------------------------- #
+    def _cache_get(self, cache: OrderedDict, key):
+        fn = cache.get(key)
+        if fn is not None:
+            cache.move_to_end(key)
+        return fn
+
+    def _cache_put(self, cache: OrderedDict, key, fn, which: str):
+        cache[key] = fn
+        cap = max(1, int(self._config.program_cache_size))
+        while len(cache) > cap:
+            old_key, _ = cache.popitem(last=False)
+            self._program_evicted(which, old_key)
+        return fn
+
+    def _program_evicted(self, which: str, key):
+        self.program_cache_evictions += 1
+        if self.telemetry is not None:
+            self.telemetry.emit("program_cache_evict",
+                                {"cache": which, "key": repr(key),
+                                 "evictions": self.program_cache_evictions})
+
     def forward(self, input_ids, *args, attention_mask=None, **kwargs):
         """Full-sequence logits (one jitted program per input shape).
         ``attention_mask`` [B, S] is honored when the model's
         ``forward_logits`` accepts it (encoder serving with padded
-        batches)."""
+        batches).  The compiled function is cached PER MASK PRESENCE —
+        a masked call never reuses (or pays for) the maskless program."""
         input_ids = jnp.asarray(input_ids)
         model = self.module
-        import inspect
         takes_mask = (hasattr(model, "forward_logits") and "attention_mask"
                       in inspect.signature(model.forward_logits).parameters)
         if attention_mask is not None and not takes_mask:
             raise ValueError("this model's forward path does not accept "
                              "attention_mask")
-        if self._forward_fn is None:
+        use_mask = attention_mask is not None
+        fn = self._cache_get(self._forward_fns, use_mask)
+        if fn is None:
 
-            def fwd(params, ids, mask):
+            def fwd(params, ids, mask=None):
                 if hasattr(model, "forward_logits"):
-                    if takes_mask:
+                    if use_mask:
                         return model.forward_logits(params, ids,
                                                     attention_mask=mask)
                     return model.forward_logits(params, ids)
@@ -197,14 +226,20 @@ class InferenceEngine:
                     params, ids, model.init_cache(ids.shape[0], ids.shape[1]))
                 return logits
 
-            self._forward_fn = jax.jit(fwd, static_argnums=()) if takes_mask \
-                else jax.jit(lambda p, i, m: fwd(p, i, None))
-        mask = (jnp.asarray(attention_mask) if attention_mask is not None
-                else jnp.ones_like(input_ids))
+            fn = jax.jit(fwd) if use_mask else jax.jit(lambda p, i: fwd(p, i))
+            self._cache_put(self._forward_fns, use_mask, fn, "forward")
+        # one jit holds one program per input shape; keep that inner cache
+        # bounded too (clear_cache drops all traces — rare, counted)
+        if fn._cache_size() >= max(1, int(self._config.program_cache_size)):
+            fn.clear_cache()
+            self._program_evicted("forward_shapes", use_mask)
         t0 = time.perf_counter()
         with self._span("inference.forward", batch=int(input_ids.shape[0]),
-                        seq=int(input_ids.shape[1])):
-            out = self._forward_fn(self.params, input_ids, mask)
+                        seq=int(input_ids.shape[1]), masked=use_mask):
+            if use_mask:
+                out = fn(self.params, input_ids, jnp.asarray(attention_mask))
+            else:
+                out = fn(self.params, input_ids)
             return self._record_request("forward", t0, out)
 
     __call__ = forward
@@ -237,12 +272,14 @@ class InferenceEngine:
             pad = jnp.zeros((B, S_pad - S), input_ids.dtype)
             ids = jnp.concatenate([input_ids, pad], axis=1)
             key = ((B, S_pad), max_new_tokens, float(temperature), "bucketed")
-            if key not in self._generate_fns:
+            fn = self._cache_get(self._generate_fns, key)
+            if fn is None:
                 def gen(params, ids, plen, r):
                     return model.generate(params, ids, max_new_tokens,
                                           rng=r, temperature=temperature,
                                           prompt_len=plen)
-                self._generate_fns[key] = jax.jit(gen)
+                fn = self._cache_put(self._generate_fns, key, jax.jit(gen),
+                                     "generate")
             r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
             t0 = time.perf_counter()
             with self._span("inference.generate", batch=B, prompt_len=S,
@@ -252,25 +289,26 @@ class InferenceEngine:
                 # decode span inside _record_request
                 with self._span("inference.prefill", batch=B, prompt_len=S,
                                 bucket=S_pad):
-                    out = self._generate_fns[key](self.params, ids,
-                                                  jnp.asarray(S, jnp.int32), r)
+                    out = fn(self.params, ids, jnp.asarray(S, jnp.int32), r)
                 # drop the pad tail: [prompt | pad | new] -> [prompt | new]
                 out = jnp.concatenate([out[:, :S], out[:, S_pad:]], axis=1)
                 return self._record_request("generate", t0, out,
                                             new_tokens=B * max_new_tokens)
         key = (input_ids.shape, max_new_tokens, float(temperature))
-        if key not in self._generate_fns:
+        fn = self._cache_get(self._generate_fns, key)
+        if fn is None:
             def gen(params, ids, r):
                 return model.generate(params, ids, max_new_tokens,
                                       rng=r, temperature=temperature)
 
-            self._generate_fns[key] = jax.jit(gen)
+            fn = self._cache_put(self._generate_fns, key, jax.jit(gen),
+                                 "generate")
         r = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
         t0 = time.perf_counter()
         with self._span("inference.generate", batch=B, prompt_len=S,
                         max_new_tokens=max_new_tokens, bucketed=False):
             with self._span("inference.prefill", batch=B, prompt_len=S):
-                out = self._generate_fns[key](self.params, input_ids, r)
+                out = fn(self.params, input_ids, r)
             return self._record_request("generate", t0, out,
                                         new_tokens=B * max_new_tokens)
 

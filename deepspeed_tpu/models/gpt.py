@@ -345,15 +345,21 @@ def apply_rope(x: Array, positions: Array, theta: float = 10000.0,
     Default: LLaMA/NeoX half-split pairing over the full head dim.
     ``rope_dim`` rotates only the first ``rope_dim`` features (GPT-J
     ``rotary_dim``, NeoX ``rotary_pct``); ``interleaved`` uses GPT-J's
-    rotate-every-two pairing ((0,1),(2,3),...)."""
+    rotate-every-two pairing ((0,1),(2,3),...).  ``positions`` is ``[S]``
+    (shared across the batch) or ``[B, S]`` (per-row — the continuous-
+    batching decode path, where every slot sits at its own position)."""
     B, S, H, D = x.shape
     rd = rope_dim or D
     xr = x[..., :rd].astype(jnp.float32)
     half = rd // 2
     freqs = (1.0 / theta) ** (jnp.arange(half, dtype=jnp.float32) / half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None]   # [S, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs   # [(B,) S, half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    if angles.ndim == 2:            # [S, half] -> broadcast over batch
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:                           # [B, S, half] -> per-row positions
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
     if interleaved:
         x1, x2 = xr[..., 0::2], xr[..., 1::2]
         rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
@@ -763,17 +769,15 @@ def _cached_attention(q, ck, cv, pos, bias=None):
     ``bias``: additive [1, H, S_q, T] logit bias (ALiBi)."""
     B, Sq, H, D = q.shape
     T, Hkv = ck.shape[1], ck.shape[2]
-    if bias is None and Hkv == H and os.environ.get("DST_PALLAS_DECODE") == "1":
-        # OPT-IN (r5): the Pallas decode kernel DMAs only the pos+Sq valid
-        # cache blocks and fuses score/softmax/PV — profiling shows the
-        # einsum below is ~45% of per-token decode time, so this is the
-        # right shape of fix — but its data-dependent DMA loop DEADLOCKED
-        # the v5e on first hardware run (the r4 kernel never ran on
-        # hardware either: Mosaic rejected its H-dim slicing at compile).
-        # CPU-interpret parity is green (tests/unit/ops/
-        # test_decode_attention.py); kept off the default path until the
-        # hardware hang is root-caused on a chip that can be safely wedged.
-        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, pallas_decode_enabled)
+    if bias is None and Hkv == H and pallas_decode_enabled():
+        # DEFAULT-ON where supported (graduated from the r5 opt-in): the
+        # Pallas decode kernel DMAs only the pos+Sq valid cache blocks and
+        # fuses score/softmax/PV — the einsum below is ~45% of per-token
+        # decode time.  ``DST_PALLAS_DECODE=0`` opts out; on CPU the lax
+        # fallback below stays the default (the interpreter is far slower
+        # than the einsum).  See README § Pallas decode kernel status.
         return decode_attention(q, ck, cv, pos)
     G = H // Hkv
     scale = 1.0 / np.sqrt(D)
@@ -922,6 +926,103 @@ def gpt_generate(cfg: GPTConfig, params: Dict, input_ids: Array,
     rngs = jax.random.split(rng, max_new_tokens)
     (_, _), toks = jax.lax.scan(step, (cache, last), rngs)
     return jnp.concatenate([input_ids, toks.T], axis=1)
+
+
+# --------------------------------------------------------------------------- #
+# Paged (block-table) serving step — the continuous-batching decode path.
+# The KV cache is a global block arena (deepspeed_tpu/serving/kv_cache.py)
+# instead of a per-call [B, max_len] tensor: physical blocks are reached
+# through each row's block table, so batch composition can change every step
+# without recompiling (tables/positions are traced int32 inputs).
+# --------------------------------------------------------------------------- #
+def gpt_paged_step(cfg: GPTConfig, params: Dict, input_ids: Array,
+                   positions: Array, k_pages: Array, v_pages: Array,
+                   block_tables: Array, write_blocks: Array,
+                   write_offsets: Array) -> Tuple[Array, Array, Array]:
+    """One fused step over the paged arena.
+
+    ``input_ids`` [B, S] — S = 1 for decode, a chunk for chunked prefill;
+    ``positions`` [B] — per-row global position of the first token (tokens
+    already resident in the row's cache); ``k_pages``/``v_pages``
+    [L, NB, BS, Hkv, D] — the global arena (block 0 is the trash block);
+    ``block_tables`` [B, MB] — logical→physical block map per row;
+    ``write_blocks``/``write_offsets`` [B, S] — physical (block, offset)
+    each new token's K/V lands in (invalid/padded tokens point at the trash
+    block).  Returns (logits [B, S, V] fp32, k_pages, v_pages).
+    """
+    assert cfg.scan_layers, "paged serving path requires scan_layers"
+    from deepspeed_tpu.ops.pallas.decode_attention import paged_attention
+    B, S = input_ids.shape
+    H, E = cfg.n_head, cfg.n_embd
+    MB = block_tables.shape[1]
+    BS = k_pages.shape[2]
+    T = MB * BS
+    dt = cfg.dtype
+    pos2d = positions[:, None] + jnp.arange(S)[None]          # [B, S]
+
+    x = params["wte"].astype(dt)[input_ids]
+    if cfg.position_encoding == "learned":
+        x = x + params["wpe"].astype(dt)[
+            jnp.clip(pos2d, 0, cfg.n_positions - 1)]
+    x = _constrain(x, mesh_lib.BATCH_AXES, None, None)
+
+    if cfg.position_encoding == "alibi":
+        from deepspeed_tpu.ops.attention import alibi_slopes
+        slopes = jnp.asarray(alibi_slopes(H))
+        kpos = jnp.arange(T)[None, None, None, :]
+        qpos = pos2d[:, None, :, None]
+        attn_bias = slopes[None, :, None, None] * (
+            kpos - qpos).astype(jnp.float32)                  # [B, H, S, T]
+    else:
+        attn_bias = None
+
+    def layer(carry, p):
+        x, kp, vp, li = carry
+        h = _norm(cfg, x, p["ln1_g"], p["ln1_b"])
+        qkv = h @ _wget(p, "qkv_w", dt)
+        if cfg.use_bias:
+            qkv = qkv + p["qkv_b"].astype(dt)
+        q, k, v = _split_qkv(cfg, qkv)
+        if cfg.position_encoding == "rope":
+            q = apply_rope(q, pos2d, cfg.rope_theta, cfg.rope_dim,
+                           cfg.rope_interleaved)
+            k = apply_rope(k, pos2d, cfg.rope_theta, cfg.rope_dim,
+                           cfg.rope_interleaved)
+        # scatter the new K/V into the arena through the write map; rows
+        # that must not write (padding, inactive slots) carry trash-block
+        # coordinates, so the scatter itself needs no predication
+        kp = kp.at[li, write_blocks, write_offsets].set(k.astype(kp.dtype))
+        vp = vp.at[li, write_blocks, write_offsets].set(v.astype(vp.dtype))
+        kl = jax.lax.dynamic_index_in_dim(kp, li, 0, keepdims=False)
+        vl = jax.lax.dynamic_index_in_dim(vp, li, 0, keepdims=False)
+        o = paged_attention(q, kl, vl, block_tables, positions,
+                            bias=attn_bias).reshape(B, S, E)
+        o = o @ _wget(p, "out_w", dt)
+        if cfg.use_bias:
+            o = o + p["out_b"].astype(dt)
+        if cfg.block_type == "sequential":
+            x = x + o
+            h2 = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+            f, _ = _ffn(cfg, p, h2, dt, train=False)
+            x = x + f
+        elif cfg.block_type == "parallel":
+            h2 = _norm(cfg, x, p["ln2_g"], p["ln2_b"])
+            f, _ = _ffn(cfg, p, h2, dt, train=False)
+            x = x + o + f
+        else:   # parallel_single_ln
+            f, _ = _ffn(cfg, p, h, dt, train=False)
+            x = x + o + f
+        return (x, kp, vp, li + 1), None
+
+    (x, k_pages, v_pages, _), _ = jax.lax.scan(
+        layer, (x, k_pages, v_pages, jnp.zeros((), jnp.int32)),
+        params["blocks"])
+    x = _norm(cfg, x, params["lnf_g"], params["lnf_b"])
+    head = params["lm_head"] if cfg.untied_head else params["wte"]
+    logits = (x @ head.astype(dt).T).astype(jnp.float32)
+    if cfg.head_bias:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
+    return logits, k_pages, v_pages
 
 
 # --------------------------------------------------------------------------- #
@@ -1081,6 +1182,14 @@ class GPT:
         return gpt_generate(self.cfg, params, input_ids, max_new_tokens,
                             rng=rng, temperature=temperature,
                             prompt_len=prompt_len)
+
+    def paged_step(self, params, input_ids, positions, k_pages, v_pages,
+                   block_tables, write_blocks, write_offsets):
+        """Serving-engine protocol: one step over the paged KV arena
+        (``deepspeed_tpu/serving/engine.py``)."""
+        return gpt_paged_step(self.cfg, params, input_ids, positions,
+                              k_pages, v_pages, block_tables,
+                              write_blocks, write_offsets)
 
     def num_params(self) -> int:
         cfg = self.cfg

@@ -46,7 +46,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-_SEMANTICS4 = pltpu.CompilerParams(
+# jax < 0.5 spells the Pallas compiler-params type ``TPUCompilerParams``.
+_SEMANTICS4 = (getattr(pltpu, "CompilerParams", None)
+               or pltpu.TPUCompilerParams)(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 

@@ -21,6 +21,7 @@ interpreter on CPU and on hardware by ``tools/decode_bench.py``.
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -32,12 +33,41 @@ from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
 
+# jax < 0.5 spells the Pallas memory-space enum ``TPUMemorySpace``.
+_MEMSPACE = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
 
 def _interpret() -> bool:
     try:
         return jax.devices()[0].platform != "tpu"
     except Exception:
         return True
+
+
+def pallas_decode_enabled() -> bool:
+    """Default-on policy for the fused decode kernel (README § Pallas decode
+    kernel status): ON where supported (TPU hardware), with
+    ``DST_PALLAS_DECODE=0`` as the opt-out; ``DST_PALLAS_DECODE=1`` forces
+    it on everywhere (including the CPU interpreter, for parity tests).
+    On CPU the default stays the lax/jnp fallback — the interpreter is
+    orders of magnitude slower than the fused einsum it would replace."""
+    env = os.environ.get("DST_PALLAS_DECODE")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return not _interpret()
+
+
+def _paged_kernel_enabled() -> bool:
+    """Same policy for the paged (block-table) kernel; independent opt-out
+    so the serving path can be steered separately (DST_PALLAS_PAGED)."""
+    env = os.environ.get("DST_PALLAS_PAGED")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return not _interpret()
 
 
 def _decode_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
@@ -98,8 +128,8 @@ def _decode_call(q, ck, cv, pos, *, bk):
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Sq, H, D), lambda b, pos_ref: (b, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_MEMSPACE.ANY),
+            pl.BlockSpec(memory_space=_MEMSPACE.ANY),
         ],
         out_specs=pl.BlockSpec((1, Sq, H, D), lambda b, pos_ref: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -133,6 +163,152 @@ def decode_attention_reference(q, ck, cv, pos):
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cv)
 
 
+# --------------------------------------------------------------------------- #
+# Paged (block-table) decode attention — the serving-engine fast path.
+#
+# The KV cache is a global arena of fixed-size blocks ([NB, BS, Hkv, D] per
+# layer); a sequence's logical positions map to physical blocks through its
+# block-table row.  Queries for row ``b`` sit at global positions
+# ``lengths[b] + arange(S_q)`` and attend causally to the gathered cache —
+# the serving-side analogue of ZeRO-Infinity's memory virtualization:
+# logical sequence memory decoupled from physical HBM placement.
+# --------------------------------------------------------------------------- #
+def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths,
+                              bias=None):
+    """jnp paged attention (parity reference and CPU/default path).
+
+    q ``[B, Sq, H, D]``; pages ``[NB, BS, Hkv, D]`` (block 0 is the shared
+    trash block); ``block_tables`` ``[B, MB]`` int32 physical block ids in
+    logical order; ``lengths`` ``[B]`` int32 — tokens already in the cache
+    for each row, i.e. the global position of the row's first query.
+    ``bias``: optional additive ``[B, H, Sq, T]`` logit bias (ALiBi),
+    T = MB * BS.  GQA-aware: grouped against the un-expanded Hkv pages.
+    """
+    B, Sq, H, D = q.shape
+    NB, BS, Hkv, _ = k_pages.shape
+    MB = block_tables.shape[1]
+    T = MB * BS
+    G = H // Hkv
+    scale = 1.0 / np.sqrt(D)
+    # gather [B, MB, BS, Hkv, D] -> [B, T, Hkv, D]: the T dim is the
+    # sequence's LOGICAL positions 0..T-1 (tables are logically ordered)
+    ck = k_pages[block_tables].reshape(B, T, Hkv, D)
+    cv = v_pages[block_tables].reshape(B, T, Hkv, D)
+    qg = q.reshape(B, Sq, Hkv, G, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale      # [B, Hkv, G, Sq, T]
+    if bias is not None:
+        s = s + bias.astype(jnp.float32).reshape(
+            bias.shape[0], Hkv, G, *bias.shape[2:])
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 1)[None]
+    qpos = (lengths[:, None, None]
+            + jax.lax.broadcasted_iota(jnp.int32, (Sq, T), 0)[None])
+    mask = kpos <= qpos                                 # [B, Sq, T]
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(q.dtype), cv)
+    return out.reshape(B, Sq, H, D)
+
+
+def _paged_kernel(len_ref, tbl_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
+                  sem_k, sem_v, *, scale, bs, Sq, H, MB):
+    """Grid (B,): per row, DMA ONLY the ``ceil((len+Sq)/bs)`` live physical
+    blocks through the block table (scalar-prefetched, so the dynamic block
+    index is known before the DMA is issued) — the same one-copy-serves-
+    every-head layout as ``_decode_kernel``."""
+    b = pl.program_id(0)
+    seq_len = len_ref[b]
+    q = q_ref[0]                                  # [Sq, H, D]
+    nk = (seq_len + Sq + bs - 1) // bs            # data-dependent trip count
+
+    def body(j, carry):
+        m, l, acc = carry
+        phys = tbl_ref[b * MB + j]                # logical block j -> physical
+        cp_k = pltpu.make_async_copy(k_hbm.at[phys], k_buf, sem_k)
+        cp_v = pltpu.make_async_copy(v_hbm.at[phys], v_buf, sem_v)
+        cp_k.start()
+        cp_v.start()
+        cp_k.wait()
+        cp_v.wait()
+        k = k_buf[...]                            # [bs, H, D]
+        v = v_buf[...]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((1,), (1,))),
+                                preferred_element_type=jnp.float32) * scale
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bs), 0)
+        cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (Sq, bs), 1)
+        s = jnp.where((cols <= seq_len + rows)[None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    D = q.shape[-1]
+    m0 = jnp.full((H, Sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, Sq, 1), jnp.float32)
+    a0 = jnp.zeros((H, Sq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def _paged_call(q, k_pages, v_pages, block_tables, lengths):
+    B, Sq, H, D = q.shape
+    NB, BS, Hkv, _ = k_pages.shape
+    MB = block_tables.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                    # lengths, flat block tables
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Sq, H, D), lambda b, len_ref, tbl_ref: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=_MEMSPACE.ANY),
+            pl.BlockSpec(memory_space=_MEMSPACE.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Sq, H, D),
+                               lambda b, len_ref, tbl_ref: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((BS, H, D), k_pages.dtype),
+            pltpu.VMEM((BS, H, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, bs=BS, Sq=Sq, H=H, MB=MB),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Sq, H, D), q.dtype),
+        interpret=_interpret(),
+    )(jnp.asarray(lengths, jnp.int32),
+      jnp.asarray(block_tables, jnp.int32).reshape(-1),
+      q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, bias=None):
+    """Block-table KV attention for the serving engine; dispatches to the
+    paged Pallas kernel where supported (TPU, MHA, no bias — DST_PALLAS_PAGED
+    overrides), else the jnp gather reference.  Sharded meshes fall back to
+    the reference path (the gather partitions cleanly under SPMD; the kernel
+    does not shard the global block arena)."""
+    B, Sq, H, D = q.shape
+    Hkv = k_pages.shape[2]
+    if (bias is not None or Hkv != H or D % 8 != 0
+            or not _paged_kernel_enabled()):
+        return paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                         lengths, bias=bias)
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    if mesh_lib.has_mesh():
+        mesh = mesh_lib.get_mesh()
+        batch_div = int(np.prod([mesh.shape[a] for a in mesh_lib.BATCH_AXES]))
+        if batch_div > 1 or int(mesh.shape["tensor"]) > 1:
+            return paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                             lengths, bias=bias)
+    return _paged_call(q, k_pages, v_pages, block_tables, lengths)
+
+
 def decode_attention(q, ck, cv, pos, *, block_k: Optional[int] = None):
     """KV-cache attention for prefill/decode; dispatches to the Pallas
     kernel when shapes allow, under shard_map when a mesh is active
@@ -154,7 +330,7 @@ def decode_attention(q, ck, cv, pos, *, block_k: Optional[int] = None):
             if B % batch_div != 0 or H % tp != 0:
                 return decode_attention_reference(q, ck, cv, pos)
             qspec = P(mesh_lib.BATCH_AXES, None, "tensor", None)
-            return jax.shard_map(
+            return mesh_lib.shard_map(
                 call, mesh=mesh,
                 in_specs=(qspec, qspec, qspec, P()),
                 out_specs=qspec, check_vma=False)(q, ck, cv, pos)

@@ -71,7 +71,12 @@ from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
 
-_PARALLEL3 = pltpu.CompilerParams(
+# jax < 0.5 spells these ``TPUCompilerParams`` / ``TPUMemorySpace``.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+_MEMSPACE = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
+_PARALLEL3 = _COMPILER_PARAMS(
     dimension_semantics=("parallel", "parallel", "parallel"))
 
 
@@ -183,7 +188,7 @@ def _fwd(q, k, v, bias, slopes, *, causal, scale, bq=None, bk=None):
         in_specs.append(_bias_spec_qrows(bias, bq, S))
         args.append(bias)
     if slopes is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM))
+        in_specs.append(pl.BlockSpec(memory_space=_MEMSPACE.SMEM))
         args.append(slopes)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
@@ -337,7 +342,7 @@ def flash_block_bwd(q, k, v, do, lse, delta, bias=None, slopes=None, *,
         dq_specs.append(_bias_spec_qrows(bias, bq_, S))
     if slopes is not None:
         dq_in.append(slopes)
-        dq_specs.append(pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM))
+        dq_specs.append(pl.BlockSpec(memory_space=_MEMSPACE.SMEM))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq_,
                           bk=bk_, S=S, has_bias=bias is not None,
@@ -362,7 +367,7 @@ def flash_block_bwd(q, k, v, do, lse, delta, bias=None, slopes=None, *,
         dkv_specs.append(_bias_spec_kcols(bias, group, bk_, S))
     if slopes is not None:
         dkv_in.append(slopes)
-        dkv_specs.append(pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM))
+        dkv_specs.append(pl.BlockSpec(memory_space=_MEMSPACE.SMEM))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq_,
                           bk=bk_, S=S, group=group, has_bias=bias is not None,
@@ -486,6 +491,7 @@ def flash_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None,
                 sl = rest[-1] if ns else None
                 return _flash_bshd(q, k, v, b, sl, causal, scale, block_q, block_k)
 
-            return jax.shard_map(inner, mesh=mesh, in_specs=tuple(in_specs),
-                                 out_specs=spec, check_vma=False)(*args)
+            from deepspeed_tpu.parallel.mesh import shard_map
+            return shard_map(inner, mesh=mesh, in_specs=tuple(in_specs),
+                             out_specs=spec, check_vma=False)(*args)
     return _flash_bshd(q, k, v, bias, slopes, causal, scale, block_q, block_k)

@@ -503,9 +503,16 @@ def load_params_only(load_dir: str, tag: Optional[str], params, shardings,
     target = {"params": jax.tree.map(
         lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, jnp.float32, sharding=s),
         params, shardings)}
-    restored = ocp.PyTreeCheckpointer().restore(
-        state_path, args=ocp.args.PyTreeRestore(item=target,
-                                                partial_restore=True))["params"]
+    try:
+        args = ocp.args.PyTreeRestore(item=target, partial_restore=True)
+    except TypeError:
+        # older orbax spells partial restore as an empty transforms dict
+        # (only the keys present in ``item`` are read from disk) and then
+        # requires explicit per-leaf restore_args
+        args = ocp.args.PyTreeRestore(
+            item=target, transforms={},
+            restore_args=ocp.checkpoint_utils.construct_restore_args(target))
+    restored = ocp.PyTreeCheckpointer().restore(state_path, args=args)["params"]
     if dtype is not None:
         restored = jax.tree.map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,

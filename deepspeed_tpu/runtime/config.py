@@ -373,6 +373,8 @@ class DeepSpeedConfig:
             **pd.get(C.FAULT_TOLERANCE, {}))
         self.stability_config = DeepSpeedStabilityConfig(
             **pd.get(C.STABILITY, {}))
+        from deepspeed_tpu.serving.config import DeepSpeedServingConfig
+        self.serving_config = DeepSpeedServingConfig(**pd.get(C.SERVING, {}))
 
         self.eigenvalue_config = EigenvalueConfig(**pd.get(C.EIGENVALUE, {}))
         self.quantize_training_config = QuantizeTrainingConfig(

@@ -138,6 +138,7 @@ STABILITY = "stability"
 ACTIVATION_CHECKPOINTING = "activation_checkpointing"
 COMMS_LOGGER = "comms_logger"
 TELEMETRY = "telemetry"
+SERVING = "serving"
 MONITOR_CONFIG_TENSORBOARD = "tensorboard"
 MONITOR_CONFIG_WANDB = "wandb"
 MONITOR_CONFIG_CSV = "csv_monitor"

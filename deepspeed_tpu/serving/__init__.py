@@ -1,0 +1,34 @@
+"""Serving subsystem: continuous batching over a paged KV-cache arena.
+
+Layering (host control plane → device data plane):
+
+* :mod:`deepspeed_tpu.serving.kv_cache` — free-list block allocator +
+  per-sequence block tables over a preallocated device arena;
+* :mod:`deepspeed_tpu.serving.scheduler` — admission, chunked prefill,
+  SLO-class preemption with eviction/recompute;
+* :mod:`deepspeed_tpu.serving.engine` — the two-program (decode + prefill)
+  jitted step and the ``submit()/step()/run()`` surface;
+* config: :class:`DeepSpeedServingConfig`, the ``"serving"`` ds_config key.
+"""
+
+from deepspeed_tpu.serving.config import DeepSpeedServingConfig
+from deepspeed_tpu.serving.engine import ServeFuture, ServingEngine, init_serving
+from deepspeed_tpu.serving.kv_cache import (ArenaExhausted, PagedKVAllocator,
+                                            arena_bytes, init_arena)
+from deepspeed_tpu.serving.scheduler import (QueueFull, Request,
+                                             ServingScheduler, SLO_PRIORITY)
+
+__all__ = [
+    "ArenaExhausted",
+    "DeepSpeedServingConfig",
+    "PagedKVAllocator",
+    "QueueFull",
+    "Request",
+    "SLO_PRIORITY",
+    "ServeFuture",
+    "ServingEngine",
+    "ServingScheduler",
+    "arena_bytes",
+    "init_arena",
+    "init_serving",
+]

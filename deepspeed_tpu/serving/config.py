@@ -1,0 +1,48 @@
+"""Serving config — the ``"serving"`` block of the ds_config document.
+
+The reference snapshot (v0.8.3) predates DeepSpeed-FastGen, so there is no
+reference config surface to mirror; the knobs follow the same shape
+philosophy as the rest of ``runtime/config.py``: one pydantic block, safe
+defaults, every field documented where it is consumed.
+
+Sizing guidance (README § Serving): ``block_size`` trades internal
+fragmentation (last-block waste, avg block_size/2 tokens per sequence)
+against block-table length and scatter/gather granularity — 16 suits toy
+and CPU runs, 32–64 suits real HBM arenas.  ``num_blocks`` bounds the
+arena: total KV bytes = 2 * n_layer * num_blocks * block_size * kv_heads *
+head_dim * dtype_bytes.
+"""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedServingConfig(DeepSpeedConfigModel):
+    """``serving`` block — continuous batching + paged KV cache
+    (``deepspeed_tpu/serving/``).  See README § Serving."""
+    enabled: bool = False
+    # ---- paged KV arena -------------------------------------------------- #
+    block_size: int = 16          # tokens per physical KV block
+    num_blocks: int = 256         # arena capacity in blocks (incl. trash)
+    max_blocks_per_seq: int = 0   # 0 -> ceil(n_positions / block_size)
+    # ---- continuous batching --------------------------------------------- #
+    max_batch_size: int = 8       # decode slots (fixed compiled batch shape)
+    prefill_chunk: int = 64       # chunked-prefill tokens per engine step
+    max_queue: int = 1024         # waiting-queue bound; submit raises past it
+    # ---- scheduling ------------------------------------------------------ #
+    slo_preemption: bool = True   # higher SLO classes may evict lower ones
+    max_new_tokens_default: int = 64
+    eos_token_id: Optional[int] = None
+    # ---- numerics / misc ------------------------------------------------- #
+    dtype: str = "bfloat16"
+    seed: int = 0
+    telemetry_every: int = 8      # serve_step gauge cadence (engine steps)
+
+    @property
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+        return {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "float16": jnp.float16, "fp16": jnp.float16,
+                "float32": jnp.float32, "fp32": jnp.float32,
+                "float": jnp.float32}[str(self.dtype)]

@@ -1,0 +1,327 @@
+"""Continuous-batching serving engine over the paged KV arena.
+
+The inference stack's ``generate()`` serves one static batch per call; this
+engine serves a *stream*: requests join and leave the decode batch every
+step without recompilation.  The trick is shape discipline — exactly TWO
+programs are ever compiled, both traces of one jitted step function:
+
+* **decode**: ``[max_batch_size, 1]`` tokens over the arena — every active
+  sequence advances one token; inactive slots carry trash-block write
+  coordinates and all-trash block tables, so batch composition is pure
+  traced *data*;
+* **prefill**: ``[1, prefill_chunk]`` tokens — one prompt chunk per step
+  (chunked prefill), so a long prompt never stalls the decode batch for
+  more than one chunk's latency.
+
+Block tables, positions, and write maps are int32 inputs produced by the
+host-side :class:`PagedKVAllocator` / :class:`ServingScheduler`; the arena
+arrays are donated back to the step on accelerators, so the KV cache is
+updated in place.  The e2e contract (tests/unit/serving): greedy outputs
+are token-identical to sequential ``generate()``, even across
+preempt→evict→recompute cycles, because recompute re-prefills a prefix of
+the identical deterministic stream.
+
+Decoding is greedy (the sampler the sequential path uses at
+``temperature=0``, including the padded-vocab mask); sampled decoding is
+future work and is rejected at ``submit()``.
+"""
+
+import time
+from contextlib import nullcontext
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.serving.config import DeepSpeedServingConfig
+from deepspeed_tpu.serving.kv_cache import (ArenaExhausted, PagedKVAllocator,
+                                            init_arena)
+from deepspeed_tpu.serving.scheduler import (DECODE, FINISHED, Request,
+                                             ServingScheduler)
+from deepspeed_tpu.telemetry.tracing import get_global_tracer
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ServeFuture:
+    """Handle for one submitted request.  ``result()`` drives the engine's
+    step loop until this request finishes (single-threaded serving — there
+    is no background thread; whoever waits, steps)."""
+
+    def __init__(self, engine: "ServingEngine", request: Request):
+        self._engine = engine
+        self.request = request
+
+    @property
+    def done(self) -> bool:
+        return self.request.state == FINISHED
+
+    @property
+    def token_ids(self) -> List[int]:
+        """Generated tokens so far (excludes the prompt)."""
+        return list(self.request.generated)
+
+    def result(self, max_steps: int = 100_000) -> List[int]:
+        for _ in range(max_steps):
+            if self.done:
+                return self.token_ids
+            self._engine.step()
+        raise TimeoutError(
+            f"request {self.request.rid} unfinished after {max_steps} steps")
+
+
+class ServingEngine:
+    """``submit()/step()/run()`` over a model implementing ``paged_step``
+    (the GPT family, ``models/gpt.py:gpt_paged_step``)."""
+
+    def __init__(self, model, config: Optional[DeepSpeedServingConfig] = None,
+                 params=None, seed: Optional[int] = None, telemetry=None,
+                 tracer=None):
+        import jax
+        import jax.numpy as jnp
+        cfg = config or DeepSpeedServingConfig()
+        self._config = cfg
+        self.telemetry = telemetry
+        self.tracer = tracer
+        self.dtype = cfg.jnp_dtype
+        assert hasattr(model, "paged_step") and hasattr(model, "cfg"), (
+            "ServingEngine needs a model with .cfg and .paged_step(...) "
+            "(the GPT family)")
+        # serve in the configured dtype without mutating the caller's model
+        if model.cfg.dtype != self.dtype:
+            import copy
+            import dataclasses
+            model = copy.copy(model)
+            model.cfg = dataclasses.replace(model.cfg, dtype=self.dtype)
+        self.module = model
+        mcfg = model.cfg
+
+        if params is None:
+            assert hasattr(model, "init_params"), (
+                "pass params= or a model with init_params(rng)")
+            params = model.init_params(
+                jax.random.PRNGKey(cfg.seed if seed is None else seed))
+        self.params = jax.tree.map(
+            lambda p: jnp.asarray(p, self.dtype)
+            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+            params)
+
+        # ---- paged arena + control plane --------------------------------- #
+        self.max_blocks_per_seq = (cfg.max_blocks_per_seq
+                                   or -(-mcfg.n_positions // cfg.block_size))
+        self.alloc = PagedKVAllocator(cfg.num_blocks, cfg.block_size,
+                                      self.max_blocks_per_seq)
+        self.sched = ServingScheduler(cfg, self.alloc, cfg.max_batch_size)
+        self.sched.on_preempt = self._on_preempt
+        self._k_pages, self._v_pages = init_arena(
+            mcfg, cfg.num_blocks, cfg.block_size, dtype=self.dtype)
+
+        # ---- the (single) jitted step ------------------------------------ #
+        def step_fn(params, ids, positions, kp, vp, tables, wb, wo):
+            logits, kp, vp = model.paged_step(params, ids, positions, kp, vp,
+                                              tables, wb, wo)
+            if mcfg.padded_vocab != mcfg.vocab_size:
+                vmask = jnp.arange(mcfg.padded_vocab) < mcfg.vocab_size
+                logits = jnp.where(vmask[None, None], logits, -1e30)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
+
+        # arena donation = in-place KV update; CPU can't donate (jax warns
+        # and copies), so only donate on real accelerators
+        donate = (3, 4) if jax.default_backend() != "cpu" else ()
+        self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+
+        self._rid_counter = 0
+        self._futures: Dict[int, ServeFuture] = {}
+        self.step_count = 0
+        self.tokens_generated = 0
+        log_dist(
+            f"ServingEngine ready: slots={cfg.max_batch_size}, "
+            f"arena={cfg.num_blocks}x{cfg.block_size} tok "
+            f"(max {self.max_blocks_per_seq} blocks/seq), "
+            f"prefill_chunk={cfg.prefill_chunk}, dtype={self.dtype.__name__}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def _span(self, name, **args):
+        tr = self.tracer if self.tracer is not None else get_global_tracer()
+        return tr.span(name, **args) if tr is not None else nullcontext()
+
+    def _emit(self, kind, payload, step=None):
+        if self.telemetry is not None:
+            self.telemetry.emit(kind, payload, step=step)
+
+    def _on_preempt(self, victim: Request):
+        self._emit("serve_preempt", {
+            "rid": victim.rid, "slo": victim.slo,
+            "generated": len(victim.generated),
+            "preemptions": victim.preemptions,
+        }, step=self.step_count)
+
+    def compiled_programs(self) -> int:
+        """Number of XLA programs behind the serving step (the e2e test
+        asserts this stays <= 2: one decode trace + one prefill trace)."""
+        return int(self._step_fn._cache_size())
+
+    # ------------------------------------------------------------------ #
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               slo: str = "standard", temperature: float = 0.0) -> ServeFuture:
+        """Queue one request; returns a :class:`ServeFuture`."""
+        if temperature:
+            raise NotImplementedError(
+                "serving is greedy-only in this PR (temperature=0)")
+        cfg, mcfg = self._config, self.module.cfg
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        assert prompt, "empty prompt"
+        mnt = int(max_new_tokens or cfg.max_new_tokens_default)
+        total = len(prompt) + mnt
+        if total > mcfg.n_positions:
+            raise ValueError(f"prompt+max_new_tokens {total} exceeds "
+                             f"n_positions {mcfg.n_positions}")
+        if self.alloc.blocks_for_tokens(total) > min(
+                cfg.num_blocks - 1, self.max_blocks_per_seq):
+            raise ArenaExhausted(
+                f"request needs {self.alloc.blocks_for_tokens(total)} blocks; "
+                f"arena ceiling is "
+                f"{min(cfg.num_blocks - 1, self.max_blocks_per_seq)}")
+        self._rid_counter += 1
+        req = Request(rid=self._rid_counter, prompt=prompt,
+                      max_new_tokens=mnt, slo=slo, arrival=time.monotonic())
+        self.sched.submit(req)
+        fut = ServeFuture(self, req)
+        self._futures[req.rid] = fut
+        self._emit("serve_request", {
+            "event": "submitted", "rid": req.rid, "slo": slo,
+            "prompt_tokens": len(prompt), "max_new_tokens": mnt,
+            "queue_depth": len(self.sched.waiting),
+        }, step=self.step_count)
+        return fut
+
+    # ------------------------------------------------------------------ #
+    def step(self) -> Dict[str, Any]:
+        """One engine step: admit, run one prefill chunk, run one decode
+        step over every decode-ready sequence.  Returns the step stats."""
+        self.sched.admit()
+        prefill_tokens = 0
+        with self._span("serve.step", step=self.step_count):
+            pf = self.sched.next_prefill()
+            if pf is not None:
+                req, start, n = pf
+                with self._span("serve.prefill", rid=req.rid, start=start,
+                                tokens=n):
+                    self._run_prefill(req, start, n)
+                prefill_tokens = n
+            # growth pass, oldest/strongest first: each decode step writes
+            # one token per sequence, so capacity must exist before the
+            # batch is built; eviction here removes victims from `active`
+            decode = sorted(self.sched.decode_batch(),
+                            key=lambda r: (r.priority, r.admit_seq))
+            for r in decode:
+                if r.state == DECODE:          # not evicted by an earlier r
+                    self.sched.ensure_capacity(r, r.prefilled + 1)
+            decode = self.sched.decode_batch()
+            if decode:
+                with self._span("serve.decode", batch=len(decode)):
+                    self._run_decode(decode)
+        self.step_count += 1
+        stats = dict(self.sched.stats(), decode_batch=len(decode),
+                     prefill_tokens=prefill_tokens,
+                     tokens_generated=self.tokens_generated)
+        if (self.telemetry is not None and self._config.telemetry_every
+                and self.step_count % self._config.telemetry_every == 0):
+            self._emit("serve_step", stats, step=self.step_count)
+        return stats
+
+    def run(self, max_steps: int = 1_000_000) -> int:
+        """Drive until every queued/active request finishes.  Returns the
+        number of steps taken."""
+        start = self.step_count
+        while self.sched.has_work:
+            if self.step_count - start >= max_steps:
+                raise TimeoutError(f"serving drain exceeded {max_steps} steps")
+            self.step()
+        return self.step_count - start
+
+    # ------------------------------------------------------------------ #
+    def _run_prefill(self, req: Request, start: int, n: int):
+        import jax.numpy as jnp
+        C = self._config.prefill_chunk
+        MB = self.max_blocks_per_seq
+        ctx = req.context
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = ctx[start:start + n]
+        positions = np.asarray([start], np.int32)
+        tables = self.alloc.block_table(req.rid)[None]           # [1, MB]
+        wb, wo = self.alloc.write_map(req.rid, start, C, n_valid=n)
+        tokens, self._k_pages, self._v_pages = self._step_fn(
+            self.params, jnp.asarray(ids), jnp.asarray(positions),
+            self._k_pages, self._v_pages, jnp.asarray(tables),
+            jnp.asarray(wb[None]), jnp.asarray(wo[None]))
+        req.prefilled += n
+        if req.prefilled >= req.prefill_len:
+            # the chunk holding the last context token also yields the next
+            # token — first-token latency includes no extra decode step
+            req.state = DECODE
+            self._append_token(req, int(np.asarray(tokens)[0, n - 1]))
+
+    def _run_decode(self, reqs: List[Request]):
+        import jax.numpy as jnp
+        B = self._config.max_batch_size
+        MB = self.max_blocks_per_seq
+        ids = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.zeros((B, MB), np.int32)      # trash-only for idle slots
+        wb = np.zeros((B, 1), np.int32)
+        wo = np.zeros((B, 1), np.int32)
+        for r in reqs:
+            s = r.slot
+            ids[s, 0] = r.context[-1]
+            positions[s] = r.prefilled
+            tables[s] = self.alloc.block_table(r.rid)
+            wb[s], wo[s] = self.alloc.write_map(r.rid, r.prefilled, 1)
+        tokens, self._k_pages, self._v_pages = self._step_fn(
+            self.params, jnp.asarray(ids), jnp.asarray(positions),
+            self._k_pages, self._v_pages, jnp.asarray(tables),
+            jnp.asarray(wb), jnp.asarray(wo))
+        tokens = np.asarray(tokens)
+        for r in reqs:
+            r.prefilled += 1          # the fed token's KV is now resident
+            self._append_token(r, int(tokens[r.slot, 0]))
+
+    def _append_token(self, req: Request, tok: int):
+        req.generated.append(tok)
+        self.tokens_generated += 1
+        if req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+        if req.done(self._config.eos_token_id):
+            req.finished_at = time.monotonic()
+            self.sched.finish(req)
+            ttft = req.first_token_at - req.arrival
+            latency = req.finished_at - req.arrival
+            self._emit("serve_request", {
+                "event": "finished", "rid": req.rid, "slo": req.slo,
+                "prompt_tokens": len(req.prompt),
+                "new_tokens": len(req.generated),
+                "ttft_ms": ttft * 1000.0,
+                "latency_ms": latency * 1000.0,
+                "tokens_per_sec": len(req.generated) / max(latency, 1e-9),
+                "preemptions": req.preemptions,
+            }, step=self.step_count)
+
+
+def init_serving(model=None, config=None, **kwargs):
+    """Module-level helper in the ``deepspeed.init_inference`` style: merge
+    a ``{"serving": {...}}`` (or flat) config dict + kwargs."""
+    cfg_dict = dict(config or {})
+    cfg_dict.update(kwargs)
+    if "serving" in cfg_dict:
+        cfg_dict = dict(cfg_dict["serving"])
+    params = cfg_dict.pop("params", None)
+    telemetry = cfg_dict.pop("telemetry", None)
+    tracer = cfg_dict.pop("tracer", None)
+    seed = cfg_dict.pop("model_seed", None)
+    if isinstance(telemetry, dict):
+        from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
+        from deepspeed_tpu.telemetry import TelemetryHub
+        tcfg = DeepSpeedTelemetryConfig(**telemetry)
+        telemetry = TelemetryHub.from_config(tcfg) if tcfg.enabled else None
+    cfg = DeepSpeedServingConfig(**cfg_dict)
+    return ServingEngine(model, config=cfg, params=params, seed=seed,
+                         telemetry=telemetry, tracer=tracer)

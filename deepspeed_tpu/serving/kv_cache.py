@@ -1,0 +1,166 @@
+"""Paged KV-cache allocator — fixed-size blocks in a preallocated arena.
+
+The serving-side analogue of ZeRO-Infinity's memory virtualization (arxiv
+2104.07857): a sequence's LOGICAL KV memory is decoupled from PHYSICAL HBM
+placement, so arena capacity — not batch shape — is the binding constraint.
+The device arena is ``[n_layer, num_blocks, block_size, kv_heads, head_dim]``
+per K and V; this module owns the host-side bookkeeping:
+
+* a free list of physical block ids (block 0 is reserved as the TRASH
+  block: padded/inactive tokens scatter their K/V there, so the compiled
+  step needs no write predication);
+* a per-sequence block table in logical order, padded to
+  ``max_blocks_per_seq`` with trash for the traced ``[B, MB]`` input;
+* eviction: a preempted sequence returns every block to the free list and
+  is later *recomputed* (re-prefilled over prompt + generated-so-far) —
+  greedy decoding makes recompute token-exact, which the e2e test proves.
+
+All methods are O(blocks touched); nothing here ever touches jax.
+"""
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ArenaExhausted(Exception):
+    """No free blocks and the caller chose not to (or could not) evict."""
+
+
+class PagedKVAllocator:
+    """Host-side free-list allocator over ``num_blocks`` physical blocks.
+
+    Block 0 is the trash block and is never handed out; usable capacity is
+    ``num_blocks - 1`` blocks = ``(num_blocks - 1) * block_size`` tokens.
+    """
+
+    TRASH = 0
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 max_blocks_per_seq: int):
+        assert num_blocks >= 2, "arena needs >= 1 usable block + trash"
+        assert block_size >= 1 and max_blocks_per_seq >= 1
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        # LIFO free list: recently-freed blocks are reused first (their
+        # pages are hot, and stale contents are fully overwritten before
+        # any masked-in position can read them)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._owned: Dict[object, List[int]] = {}   # seq id -> blocks, logical order
+        self.eviction_count = 0
+
+    # -- capacity queries -------------------------------------------------- #
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-max(0, int(n_tokens)) // self.block_size)
+
+    def capacity_tokens(self) -> int:
+        """Largest single-sequence footprint this arena can ever hold."""
+        return min(self.num_blocks - 1, self.max_blocks_per_seq) * self.block_size
+
+    def can_allocate(self, seq_id, n_tokens: int) -> bool:
+        need = self.blocks_for_tokens(n_tokens) - len(self._owned.get(seq_id, ()))
+        return need <= self.free_blocks
+
+    # -- lifecycle --------------------------------------------------------- #
+    def allocate(self, seq_id, n_tokens: int) -> bool:
+        """Grow ``seq_id``'s block list to cover ``n_tokens`` logical
+        tokens.  Returns False (state unchanged) when the free list cannot
+        cover the growth — the scheduler then evicts a victim and retries.
+        Raises when a single sequence exceeds ``max_blocks_per_seq``."""
+        owned = self._owned.setdefault(seq_id, [])
+        need = self.blocks_for_tokens(n_tokens)
+        if need > self.max_blocks_per_seq:
+            raise ArenaExhausted(
+                f"sequence needs {need} blocks > max_blocks_per_seq "
+                f"{self.max_blocks_per_seq}")
+        grow = need - len(owned)
+        if grow <= 0:
+            return True
+        if grow > len(self._free):
+            if not owned:
+                del self._owned[seq_id]
+            return False
+        owned.extend(self._free.pop() for _ in range(grow))
+        return True
+
+    def free(self, seq_id) -> int:
+        """Return every block of ``seq_id`` to the free list; idempotent on
+        unknown ids (a finished-then-evicted race is not an error)."""
+        blocks = self._owned.pop(seq_id, [])
+        self._free.extend(reversed(blocks))
+        return len(blocks)
+
+    def evict(self, seq_id) -> int:
+        """Preemption-path free: same reclamation, counted separately so
+        telemetry can distinguish completion from eviction."""
+        n = self.free(seq_id)
+        if n:
+            self.eviction_count += 1
+        return n
+
+    # -- table / write-map construction (traced-input shaping) ------------- #
+    def block_table(self, seq_id) -> np.ndarray:
+        """[max_blocks_per_seq] int32 physical ids, trash-padded."""
+        table = np.full((self.max_blocks_per_seq,), self.TRASH, np.int32)
+        owned = self._owned.get(seq_id, ())
+        table[:len(owned)] = owned
+        return table
+
+    def write_map(self, seq_id, start: int, n_tokens: int,
+                  n_valid: Optional[int] = None):
+        """Physical (block, offset) for tokens at logical positions
+        ``start .. start + n_tokens - 1``; positions past ``n_valid``
+        (pad tail of a bucketed prefill chunk) are routed to the trash
+        block.  → ([n_tokens] int32 blocks, [n_tokens] int32 offsets)."""
+        owned = self._owned.get(seq_id, ())
+        pos = start + np.arange(int(n_tokens))
+        logical = pos // self.block_size
+        nv = int(n_tokens) if n_valid is None else min(int(n_valid), int(n_tokens))
+        assert nv == 0 or logical[nv - 1] < max(len(owned), 1), (
+            f"write past allocation: pos {pos[nv - 1]} needs block "
+            f"{logical[nv - 1]}, own {len(owned)}")
+        phys = np.asarray([owned[b] if b < len(owned) else self.TRASH
+                           for b in logical], np.int32)
+        off = (pos % self.block_size).astype(np.int32)
+        if n_valid is not None and n_valid < n_tokens:
+            phys[n_valid:] = self.TRASH
+        return phys, off
+
+    # -- invariants (tests) ------------------------------------------------ #
+    def check_consistent(self):
+        """Every physical block is exactly one of: trash, free, or owned by
+        exactly one sequence.  Raises AssertionError on violation."""
+        seen = {self.TRASH}
+        for seq_id, blocks in self._owned.items():
+            for b in blocks:
+                assert 0 < b < self.num_blocks, f"bad block id {b}"
+                assert b not in seen, f"block {b} double-owned ({seq_id})"
+                seen.add(b)
+        for b in self._free:
+            assert b not in seen, f"block {b} both free and owned"
+            seen.add(b)
+        assert len(seen) == self.num_blocks, (
+            f"leaked blocks: {self.num_blocks - len(seen)}")
+
+
+def init_arena(cfg, num_blocks: int, block_size: int, dtype=None):
+    """Device arena pair for ``models/gpt.py:gpt_paged_step``:
+    K/V ``[n_layer, num_blocks, block_size, kv_heads, head_dim]``."""
+    import jax.numpy as jnp
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layer, num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def arena_bytes(cfg, num_blocks: int, block_size: int, dtype_bytes: int = 2) -> int:
+    return (2 * cfg.n_layer * num_blocks * block_size * cfg.kv_heads
+            * cfg.head_dim * dtype_bytes)

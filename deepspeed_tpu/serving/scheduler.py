@@ -1,0 +1,228 @@
+"""Request queue + continuous-batching scheduler.
+
+Pure-host control plane for ``serving/engine.py``: admission, the
+prefill/decode split with chunked prefill, and SLO-class preemption.  The
+scheduler owns request state and drives the :class:`PagedKVAllocator`; it
+never touches jax, so every policy below is unit-testable on CPU in
+microseconds.
+
+Scheduling policy (see README § Serving):
+
+* **Admission** is continuous: whenever a decode slot and enough arena
+  blocks are free, the best waiting request — ordered by (SLO priority,
+  submit order) — is admitted.  Head-of-line blocking on an arena-full
+  condition is deliberate: skipping ahead would starve long prompts.
+* **Chunked prefill**: one prompt chunk (``prefill_chunk`` tokens) is
+  processed per engine step, so a long prompt never stalls the decode
+  batch for more than one chunk's latency.
+* **Preemption** frees a victim's blocks (eviction) and requeues it for
+  *recompute* — on resume the prompt + generated-so-far is re-prefilled,
+  which under greedy decoding continues the identical token stream.
+  Victim order is weakest SLO class first, then youngest admission, and
+  never the requester — so the oldest admitted request always progresses
+  and the eviction loop terminates.
+"""
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.serving.kv_cache import ArenaExhausted, PagedKVAllocator
+
+# SLO classes, strongest first; lower number = higher priority.
+SLO_PRIORITY = {"realtime": 0, "standard": 1, "batch": 2}
+
+WAITING = "waiting"
+PREFILL = "prefill"
+DECODE = "decode"
+FINISHED = "finished"
+
+
+class QueueFull(Exception):
+    """submit() past ``max_queue`` — shed load at the front door."""
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    slo: str = "standard"
+    arrival: float = 0.0               # host clock, supplied by the engine
+    state: str = WAITING
+    generated: List[int] = field(default_factory=list)
+    prefilled: int = 0                 # context tokens with KV in the arena
+    prefill_len: int = 0               # prefill target, set at admission
+    slot: int = -1                     # decode-batch slot while active
+    submit_seq: int = -1               # FIFO key (stable across preemption)
+    admit_seq: int = -1                # youngest-victim key, per admission
+    preemptions: int = 0
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def priority(self) -> int:
+        return SLO_PRIORITY.get(self.slo, SLO_PRIORITY["standard"])
+
+    @property
+    def context(self) -> List[int]:
+        """Tokens whose KV must exist before the next decode step."""
+        return self.prompt + self.generated
+
+    @property
+    def needs_prefill(self) -> bool:
+        return self.state == PREFILL and self.prefilled < self.prefill_len
+
+    def done(self, eos_token_id: Optional[int]) -> bool:
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return (eos_token_id is not None and self.generated
+                and self.generated[-1] == eos_token_id)
+
+
+class ServingScheduler:
+    def __init__(self, cfg, allocator: PagedKVAllocator, num_slots: int):
+        self.cfg = cfg
+        self.alloc = allocator
+        self.num_slots = int(num_slots)
+        self.waiting: deque = deque()
+        self.active: Dict[int, Request] = {}      # slot -> request
+        self._free_slots: List[int] = list(range(self.num_slots - 1, -1, -1))
+        self._submit_counter = itertools.count()
+        self._admit_counter = itertools.count()
+        self.preemption_count = 0
+        self.finished_count = 0
+        # engine hook: called with the victim after each eviction (telemetry)
+        self.on_preempt = None
+
+    # ---- intake ----------------------------------------------------------- #
+    def submit(self, req: Request) -> Request:
+        if len(self.waiting) >= self.cfg.max_queue:
+            raise QueueFull(f"waiting queue at max_queue={self.cfg.max_queue}")
+        req.submit_seq = next(self._submit_counter)
+        req.state = WAITING
+        self.waiting.append(req)
+        return req
+
+    def _pop_best_waiting(self) -> Optional[Request]:
+        if not self.waiting:
+            return None
+        best = min(self.waiting, key=lambda r: (r.priority, r.submit_seq))
+        self.waiting.remove(best)
+        return best
+
+    # ---- admission -------------------------------------------------------- #
+    def admit(self) -> List[Request]:
+        """Fill free decode slots from the waiting queue.  Returns the
+        newly admitted requests (their prefill starts next step)."""
+        admitted = []
+        while self._free_slots:
+            req = self._pop_best_waiting()
+            if req is None:
+                break
+            target = len(req.context)
+            while not self.alloc.allocate(req.rid, target):
+                victim = self._admission_victim(req)
+                if victim is None:
+                    # Arena full and nothing evictable below this class:
+                    # head-of-line blocks until decode frees capacity.
+                    self.waiting.appendleft(req)
+                    return admitted
+                self.preempt(victim)
+            req.slot = self._free_slots.pop()
+            req.admit_seq = next(self._admit_counter)
+            req.prefill_len = target
+            req.prefilled = 0
+            req.state = PREFILL
+            self.active[req.slot] = req
+            admitted.append(req)
+        return admitted
+
+    # ---- preemption ------------------------------------------------------- #
+    def _victim_order(self, candidates: List[Request]) -> List[Request]:
+        # weakest SLO class first, then youngest admission
+        return sorted(candidates, key=lambda r: (-r.priority, -r.admit_seq))
+
+    def _admission_victim(self, incoming: Request) -> Optional[Request]:
+        if not self.cfg.slo_preemption:
+            return None
+        weaker = [r for r in self.active.values()
+                  if r.priority > incoming.priority]
+        order = self._victim_order(weaker)
+        return order[0] if order else None
+
+    def _growth_victim(self, requester: Request) -> Optional[Request]:
+        others = [r for r in self.active.values() if r is not requester]
+        order = self._victim_order(others)
+        return order[0] if order else None
+
+    def preempt(self, victim: Request) -> None:
+        """Evict ``victim``'s blocks and requeue it for recompute."""
+        assert victim.slot in self.active and self.active[victim.slot] is victim
+        del self.active[victim.slot]
+        self._free_slots.append(victim.slot)
+        self.alloc.evict(victim.rid)
+        victim.slot = -1
+        victim.prefilled = 0
+        victim.state = WAITING
+        victim.preemptions += 1
+        self.preemption_count += 1
+        self.waiting.appendleft(victim)   # submit_seq keeps its FIFO place
+        if self.on_preempt is not None:
+            self.on_preempt(victim)
+
+    def ensure_capacity(self, req: Request, n_tokens: int) -> None:
+        """Guarantee ``req`` owns blocks for ``n_tokens`` context tokens,
+        evicting victims under arena pressure.  The victim order excludes
+        the requester, so the loop strictly shrinks the active set and
+        terminates; if the requester alone exceeds the arena we raise."""
+        while not self.alloc.allocate(req.rid, n_tokens):
+            victim = self._growth_victim(req)
+            if victim is None:
+                raise ArenaExhausted(
+                    f"request {req.rid} needs "
+                    f"{self.alloc.blocks_for_tokens(n_tokens)} blocks; arena "
+                    f"has {self.alloc.num_blocks - 1} usable")
+            self.preempt(victim)
+
+    # ---- per-step work selection ------------------------------------------ #
+    def next_prefill(self) -> Optional[Tuple[Request, int, int]]:
+        """One (request, start, n_tokens) prompt chunk for this step, or
+        None.  Strongest class / oldest admission goes first."""
+        pending = [r for r in self.active.values() if r.needs_prefill]
+        if not pending:
+            return None
+        req = min(pending, key=lambda r: (r.priority, r.admit_seq))
+        start = req.prefilled
+        n = min(self.cfg.prefill_chunk, req.prefill_len - start)
+        return req, start, n
+
+    def decode_batch(self) -> List[Request]:
+        return [r for r in self.active.values() if r.state == DECODE]
+
+    # ---- completion ------------------------------------------------------- #
+    def finish(self, req: Request) -> None:
+        assert req.slot in self.active and self.active[req.slot] is req
+        del self.active[req.slot]
+        self._free_slots.append(req.slot)
+        self.alloc.free(req.rid)
+        req.slot = -1
+        req.state = FINISHED
+        self.finished_count += 1
+
+    # ---- introspection ---------------------------------------------------- #
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.active)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "queue_depth": len(self.waiting),
+            "active": len(self.active),
+            "free_slots": len(self._free_slots),
+            "blocks_in_use": self.alloc.blocks_in_use,
+            "blocks_free": self.alloc.free_blocks,
+            "preemptions": self.preemption_count,
+            "finished": self.finished_count,
+        }

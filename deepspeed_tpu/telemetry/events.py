@@ -35,11 +35,16 @@ LR_BACKOFF = "lr_backoff"          # recovery ladder scaled the LR schedule
 AUTO_ROLLBACK = "auto_rollback"    # ladder rolled back to a verified tag
 BATCH_QUARANTINED = "batch_quarantined"  # fingerprint quarantined / skipped
 EF_RESET = "ef_reset"              # compression error-feedback zeroed at load
+SERVE_REQUEST = "serve_request"    # one completed ServingEngine request (TTFT)
+SERVE_STEP = "serve_step"          # serving-loop gauges (queue depth, blocks)
+SERVE_PREEMPT = "serve_preempt"    # SLO/arena preemption (blocks evicted)
+PROGRAM_CACHE = "program_cache_evict"  # inference per-shape LRU cache eviction
 SCHEMA = "schema"                  # JSONL header record (written by the sink)
 
 KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
          WORKER_EXIT, CKPT_SAVED, CKPT_RETRY, CKPT_ROLLBACK, PREEMPTION,
          ANOMALY, LR_BACKOFF, AUTO_ROLLBACK, BATCH_QUARANTINED, EF_RESET,
+         SERVE_REQUEST, SERVE_STEP, SERVE_PREEMPT, PROGRAM_CACHE,
          SCHEMA)
 
 # Every `step` record carries at least these keys once drained.

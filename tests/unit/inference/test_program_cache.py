@@ -1,0 +1,83 @@
+"""InferenceEngine compiled-program cache tests: LRU bound + eviction
+telemetry on ``_generate_fns``, and ``forward()`` keyed on mask presence
+(a masked call must never silently reuse the maskless program)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.bert import Bert, bert_config
+from deepspeed_tpu.models.gpt import GPT, gpt_config
+from deepspeed_tpu.telemetry.hub import RingBufferSink, TelemetryHub
+
+
+def gpt_engine(**cfg):
+    model = GPT(gpt_config("tiny", attn_impl="reference", dtype=jnp.float32))
+    return deepspeed_tpu.init_inference(model=model,
+                                        config={"dtype": "float32", **cfg})
+
+
+def test_generate_cache_lru_eviction_and_telemetry():
+    ring = RingBufferSink(capacity=256)
+    hub = TelemetryHub(sinks=[ring], flush_every=0)
+    engine = gpt_engine(program_cache_size=2)
+    engine.telemetry = hub
+    ids = jnp.asarray([[5, 7, 11]], jnp.int32)
+    # three distinct (shape, max_new_tokens) keys against a cap of 2
+    for mnt in (2, 3, 4):
+        engine.generate(ids, max_new_tokens=mnt)
+    assert len(engine._generate_fns) == 2
+    assert engine.program_cache_evictions == 1
+    hub.flush()
+    evicts = ring.of_kind("program_cache_evict")
+    assert len(evicts) == 1
+    assert evicts[0]["cache"] == "generate" and evicts[0]["evictions"] == 1
+
+
+def test_generate_cache_lru_recency_order():
+    """Re-touching an entry must protect it: the least-RECENT program is
+    evicted, not the least-recently-INSERTED one."""
+    engine = gpt_engine(program_cache_size=2)
+    ids = jnp.asarray([[5, 7, 11]], jnp.int32)
+    engine.generate(ids, max_new_tokens=2)       # A
+    engine.generate(ids, max_new_tokens=3)       # B
+    engine.generate(ids, max_new_tokens=2)       # touch A -> B is now LRU
+    engine.generate(ids, max_new_tokens=4)       # C evicts B
+    kept = {k[1] for k in engine._generate_fns}  # key[1] == max_new_tokens
+    assert kept == {2, 4}
+    # the cached program is reused, not recompiled: greedy replay matches
+    out = engine.generate(ids, max_new_tokens=2)
+    out2 = engine.generate(ids, max_new_tokens=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_forward_keyed_on_mask_presence():
+    model = Bert(bert_config("tiny", dtype=jnp.float32))
+    engine = deepspeed_tpu.init_inference(model=model,
+                                          config={"dtype": "float32"})
+    ids = jnp.asarray(np.random.default_rng(0).integers(1, 100, (2, 8)),
+                      jnp.int32)
+    plain = engine.forward(ids)
+    assert set(engine._forward_fns) == {False}
+    # an all-ones mask is semantically a no-op: same logits, NEW program
+    masked = engine.forward(ids, attention_mask=jnp.ones((2, 8), jnp.int32))
+    assert set(engine._forward_fns) == {False, True}
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(masked),
+                               atol=1e-5, rtol=1e-5)
+    # a real padding mask must change the output (proves the mask is
+    # actually threaded through, i.e. the maskless program wasn't reused)
+    mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]] * 2, jnp.int32)
+    padded = engine.forward(ids, attention_mask=mask)
+    assert not np.allclose(np.asarray(plain)[:, :4], np.asarray(padded)[:, :4])
+
+
+def test_forward_mask_rejected_when_model_lacks_it():
+    engine = gpt_engine()
+    ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+    try:
+        engine.forward(ids, attention_mask=jnp.ones((1, 3), jnp.int32))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("GPT forward must reject attention_mask")

@@ -1,0 +1,123 @@
+"""Paged (block-table) decode attention: reference parity, Pallas-interpret
+parity, masking of stale arena contents, and the default-on env policy."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.decode_attention import (
+    _paged_kernel_enabled, decode_attention_reference, paged_attention,
+    paged_attention_reference, pallas_decode_enabled)
+
+
+def make_paged(B=2, Sq=1, H=4, D=16, Hkv=None, NB=24, BS=8, MB=8, seed=0,
+               length=20):
+    """Random arena + per-row tables mapping logical block j to a distinct
+    physical block, plus the dense gathered equivalent."""
+    Hkv = Hkv or H
+    rng = np.random.default_rng(seed)
+    k_pages = rng.standard_normal((NB, BS, Hkv, D)).astype(np.float32)
+    v_pages = rng.standard_normal((NB, BS, Hkv, D)).astype(np.float32)
+    tables = np.zeros((B, MB), np.int32)
+    free = list(range(1, NB))
+    rng.shuffle(free)
+    for b in range(B):
+        for j in range(MB):
+            tables[b, j] = free.pop()
+    q = rng.standard_normal((B, Sq, H, D)).astype(np.float32)
+    lengths = np.full((B,), length, np.int32)
+    return (jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(tables), jnp.asarray(lengths))
+
+
+def test_reference_matches_dense_cache():
+    """Gathering pages through the table and running dense full-cache
+    attention must equal the paged reference exactly."""
+    q, kp, vp, tables, lengths = make_paged(Sq=1, length=20)
+    B, Sq, H, D = q.shape
+    T = tables.shape[1] * kp.shape[1]
+    ck = kp[tables].reshape(B, T, H, D)
+    cv = vp[tables].reshape(B, T, H, D)
+    ref = decode_attention_reference(q, ck, cv, jnp.asarray(20, jnp.int32))
+    out = paged_attention_reference(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_reference_gqa_matches_expanded_heads():
+    q, kp, vp, tables, lengths = make_paged(H=8, Hkv=2, length=13)
+    B, Sq, H, D = q.shape
+    T = tables.shape[1] * kp.shape[1]
+    # expand 2 kv heads to 8 query heads and use the dense MHA reference
+    ck = jnp.repeat(kp[tables].reshape(B, T, 2, D), 4, axis=2)
+    cv = jnp.repeat(vp[tables].reshape(B, T, 2, D), 4, axis=2)
+    ref = decode_attention_reference(q, ck, cv, jnp.asarray(13, jnp.int32))
+    out = paged_attention_reference(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_stale_arena_contents_masked():
+    """Positions past ``lengths`` (trash-padded table slots, stale block
+    tails from a previous owner) must not change the output."""
+    q, kp, vp, tables, lengths = make_paged(length=11)
+    out = paged_attention_reference(q, kp, vp, tables, lengths)
+    BS = kp.shape[1]
+    # clobber everything past logical position lengths+Sq-1 = 11
+    kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+    for b in range(tables.shape[0]):
+        for j in range(tables.shape[1]):
+            for o in range(BS):
+                if j * BS + o > 11:
+                    kp2[tables[b, j], o] = 1e3
+                    vp2[tables[b, j], o] = -1e3
+    kp2[0] = 7e3                                    # trash block is never read
+    out2 = paged_attention_reference(q, jnp.asarray(kp2), jnp.asarray(vp2),
+                                     tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               atol=1e-6, rtol=1e-6)
+
+
+@pytest.mark.parametrize("Sq,length", [(1, 20), (1, 0), (4, 9)])
+def test_pallas_kernel_parity(monkeypatch, Sq, length):
+    """Forced-on Pallas paged kernel (interpret mode on CPU) vs the jnp
+    reference, decode and chunked-prefill shapes, per-row lengths."""
+    monkeypatch.setenv("DST_PALLAS_PAGED", "1")
+    q, kp, vp, tables, lengths = make_paged(Sq=Sq, length=length, seed=3)
+    lengths = jnp.asarray([length, max(0, length - 5)], jnp.int32)
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    out = paged_attention(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_dispatch_falls_back_on_bias_and_gqa(monkeypatch):
+    """Unsupported kernel shapes (ALiBi bias, grouped heads) must route to
+    the reference even when the kernel is forced on."""
+    monkeypatch.setenv("DST_PALLAS_PAGED", "1")
+    q, kp, vp, tables, lengths = make_paged(H=8, Hkv=2, length=10)
+    out = paged_attention(q, kp, vp, tables, lengths)     # GQA -> reference
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+    q2, kp2, vp2, tables2, lengths2 = make_paged(length=10)
+    T = tables2.shape[1] * kp2.shape[1]
+    bias = jnp.zeros((2, 4, 1, T), jnp.float32)
+    out2 = paged_attention(q2, kp2, vp2, tables2, lengths2, bias=bias)
+    ref2 = paged_attention_reference(q2, kp2, vp2, tables2, lengths2,
+                                     bias=bias)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2))
+
+
+def test_env_policy_default_on_with_opt_out(monkeypatch):
+    """Graduation contract: default-on where supported (off on CPU, where
+    only the interpreter exists), ``=0`` opt-out, ``=1`` force-on."""
+    for fn, var in ((pallas_decode_enabled, "DST_PALLAS_DECODE"),
+                    (_paged_kernel_enabled, "DST_PALLAS_PAGED")):
+        monkeypatch.delenv(var, raising=False)
+        assert fn() == (jax.default_backend() != "cpu")
+        monkeypatch.setenv(var, "0")
+        assert fn() is False
+        monkeypatch.setenv(var, "1")
+        assert fn() is True

@@ -1,0 +1,103 @@
+"""PagedKVAllocator unit tests — alloc/free/evict invariants and
+block-table/write-map correctness.  Pure host logic, no jax."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.kv_cache import ArenaExhausted, PagedKVAllocator
+
+
+def make(num_blocks=8, block_size=4, max_blocks=6):
+    return PagedKVAllocator(num_blocks, block_size, max_blocks)
+
+
+def test_initial_state():
+    a = make()
+    assert a.free_blocks == 7          # block 0 reserved as trash
+    assert a.blocks_in_use == 0
+    assert a.capacity_tokens() == 6 * 4
+    a.check_consistent()
+
+
+def test_allocate_grow_and_table_prefix_stable():
+    a = make()
+    assert a.allocate("s", 10)         # ceil(10/4) = 3 blocks
+    assert a.blocks_in_use == 3
+    t1 = a.block_table("s")
+    assert t1.dtype == np.int32 and t1.shape == (6,)
+    assert (t1[:3] > 0).all() and (t1[3:] == 0).all()
+    assert a.allocate("s", 13)         # grows to 4 blocks
+    t2 = a.block_table("s")
+    # growth appends: already-written blocks keep their physical identity
+    assert (t2[:3] == t1[:3]).all() and t2[3] > 0
+    # shrink request is a no-op
+    assert a.allocate("s", 2)
+    assert a.blocks_in_use == 4
+    a.check_consistent()
+
+
+def test_allocate_failure_leaves_state_unchanged():
+    a = make(num_blocks=4)             # 3 usable blocks
+    assert a.allocate("a", 8)          # 2 blocks
+    assert not a.allocate("b", 8)      # needs 2, only 1 free
+    assert "b" not in a._owned and a.free_blocks == 1
+    # partial-grow failure keeps existing ownership intact
+    assert a.allocate("b", 4)
+    assert not a.allocate("b", 12)
+    assert len(a._owned["b"]) == 1
+    a.check_consistent()
+
+
+def test_free_and_evict():
+    a = make()
+    a.allocate("a", 9)
+    n = a.free("a")
+    assert n == 3 and a.free_blocks == 7 and a.eviction_count == 0
+    assert a.free("a") == 0            # idempotent
+    a.allocate("b", 5)
+    assert a.evict("b") == 2 and a.eviction_count == 1
+    assert a.evict("b") == 0 and a.eviction_count == 1
+    a.check_consistent()
+
+
+def test_blocks_reused_after_free():
+    a = make(num_blocks=4)
+    a.allocate("a", 12)                # all 3 usable blocks
+    assert not a.can_allocate("b", 4)
+    a.free("a")
+    assert a.can_allocate("b", 12) and a.allocate("b", 12)
+    a.check_consistent()
+
+
+def test_max_blocks_per_seq_raises():
+    a = make(num_blocks=32, max_blocks=2)
+    with pytest.raises(ArenaExhausted):
+        a.allocate("s", 12)            # 3 blocks > max 2
+
+
+def test_write_map_positions_and_pad_tail():
+    a = make(block_size=4)
+    a.allocate("s", 12)
+    tbl = a.block_table("s")
+    blocks, offs = a.write_map("s", 5, 4)
+    # logical positions 5..8 -> (block 1, off 1..3) then (block 2, off 0)
+    assert list(offs) == [1, 2, 3, 0]
+    assert list(blocks) == [tbl[1], tbl[1], tbl[1], tbl[2]]
+    # padded prefill chunk: the invalid tail routes to the trash block
+    blocks, offs = a.write_map("s", 8, 4, n_valid=2)
+    assert (blocks[:2] == tbl[2]).all() and (blocks[2:] == 0).all()
+
+
+def test_write_past_allocation_asserts():
+    a = make(block_size=4)
+    a.allocate("s", 4)
+    with pytest.raises(AssertionError):
+        a.write_map("s", 4, 1)
+
+
+def test_consistency_detects_double_ownership():
+    a = make()
+    a.allocate("a", 4)
+    a._owned["b"] = list(a._owned["a"])   # corrupt: same block, two owners
+    with pytest.raises(AssertionError):
+        a.check_consistent()

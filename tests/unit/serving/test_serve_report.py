@@ -1,0 +1,117 @@
+"""tools/serve_report.py CLI tests — synthetic telemetry JSONL in, JSON
+report + gate exit codes out.  Stdlib only (the tool imports no jax)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+@pytest.fixture(scope="module")
+def tool():
+    spec = importlib.util.spec_from_file_location(
+        "serve_report", os.path.join(REPO_ROOT, "tools", "serve_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def sample_records():
+    recs = [{"kind": "schema", "schema": 1}]
+    for i, (ttft, lat, slo) in enumerate(
+            [(12.0, 80.0, "standard"), (8.0, 60.0, "realtime"),
+             (30.0, 200.0, "batch"), (15.0, 95.0, "standard")]):
+        recs.append({"kind": "serve_request", "event": "submitted",
+                     "rid": i, "slo": slo, "prompt_tokens": 8})
+        recs.append({"kind": "serve_request", "event": "finished", "rid": i,
+                     "slo": slo, "new_tokens": 10, "ttft_ms": ttft,
+                     "latency_ms": lat, "tokens_per_sec": 10_000.0 / lat,
+                     "preemptions": 0})
+    recs.append({"kind": "serve_preempt", "rid": 2, "slo": "batch",
+                 "generated": 3, "preemptions": 1})
+    recs.append({"kind": "serve_step", "queue_depth": 3, "active": 4,
+                 "blocks_in_use": 17, "free_slots": 0})
+    recs.append({"kind": "serve_step", "queue_depth": 1, "active": 2,
+                 "blocks_in_use": 9, "free_slots": 2})
+    return recs
+
+
+def test_report_folds_and_passes_gates(tool, tmp_path, capsys):
+    path = write_jsonl(tmp_path / "t.jsonl", sample_records())
+    rc = tool.main([path, "--p99-ttft-ms", "50", "--max-preemption-rate", "1"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["submitted"] == 4 and report["finished"] == 4
+    assert report["new_tokens"] == 40
+    assert report["preemptions"] == 1 and report["preemption_rate"] == 0.25
+    assert report["p99_ttft_ms"] == 30.0
+    assert report["peaks"] == {"queue_depth": 3, "active": 4,
+                               "blocks_in_use": 17}
+    assert set(report["by_slo"]) == {"standard", "realtime", "batch"}
+    assert report["by_slo"]["standard"]["finished"] == 2
+
+
+def test_gate_failure_exits_1(tool, tmp_path, capsys):
+    path = write_jsonl(tmp_path / "t.jsonl", sample_records())
+    assert tool.main([path, "--p99-ttft-ms", "20"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["ok"] and not report["gates"]["p99_ttft_ms"]["ok"]
+
+
+def test_json_out_and_torn_tail(tool, tmp_path):
+    path = write_jsonl(tmp_path / "t.jsonl", sample_records())
+    with open(path, "a") as f:
+        f.write('{"kind": "serve_req')          # torn tail from a crash
+    out = tmp_path / "report.json"
+    assert tool.main([path, "--json", str(out)]) == 0
+    assert json.loads(out.read_text())["finished"] == 4
+
+
+def test_usage_errors_exit_2(tool, tmp_path):
+    assert tool.main([str(tmp_path / "missing.jsonl")]) == 2
+    junk = tmp_path / "junk.jsonl"
+    junk.write_text("not json at all\n")
+    assert tool.main([str(junk)]) == 2
+    # telemetry file with no serving records is a usage error too
+    other = write_jsonl(tmp_path / "train.jsonl",
+                        [{"kind": "step", "step": 1, "loss": 1.0}])
+    assert tool.main([other]) == 2
+
+
+def test_engine_jsonl_roundtrip(tool, tmp_path, capsys):
+    """Full integration: ServingEngine -> JsonlSink -> serve_report."""
+    jax = pytest.importorskip("jax")
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+    from deepspeed_tpu.serving import DeepSpeedServingConfig, ServingEngine
+    from deepspeed_tpu.telemetry.hub import JsonlSink, TelemetryHub
+
+    cfg = GPTConfig(vocab_size=64, n_positions=64, n_embd=32, n_layer=2,
+                    n_head=4, dtype="float32")
+    model = GPT(cfg)
+    path = str(tmp_path / "serve.jsonl")
+    hub = TelemetryHub(sinks=[JsonlSink(path)], flush_every=0)
+    eng = ServingEngine(
+        model, config=DeepSpeedServingConfig(
+            block_size=8, num_blocks=32, max_batch_size=2, prefill_chunk=8,
+            dtype="float32", telemetry_every=1),
+        telemetry=hub)
+    for n in (4, 9, 6):
+        eng.submit(list(range(1, n + 1)), max_new_tokens=5)
+    eng.run()
+    hub.flush()
+    hub.close()
+
+    assert tool.main([path, "--p99-ttft-ms", "60000"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["finished"] == 3 and report["new_tokens"] == 15

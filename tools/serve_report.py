@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Offline serving report.
+
+Reads a telemetry JSONL file from a ``ServingEngine`` run (records emitted
+through the PR 1 hub: ``serve_request``, ``serve_step``, ``serve_preempt``)
+and folds it into the serving SLO summary — TTFT percentiles, sustained
+tokens/s, queue-depth and arena-occupancy peaks, preemption counts.  Same
+family as ``tools/stability_report.py``: forensics over run artifacts, no
+jax required.
+
+Usage::
+
+    python tools/serve_report.py TELEMETRY_JSONL
+        [--p99-ttft-ms X] [--max-preemption-rate X] [--json OUT]
+
+Gates (optional): ``--p99-ttft-ms`` fails (exit 1) when the p99
+time-to-first-token exceeds the bound; ``--max-preemption-rate`` fails
+when preemptions per finished request exceed the bound.  Exit 2 on usage
+errors (unreadable file / not a telemetry JSONL / no serving records).
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_records(path: str):
+    """→ (records list, error string or None).  Tolerates torn tail lines
+    (a crashed run) but rejects files with no parseable telemetry records."""
+    if not os.path.isfile(path):
+        return None, f"{path}: not a file"
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue     # torn tail line from a crashed run
+                if isinstance(rec, dict) and "kind" in rec:
+                    records.append(rec)
+    except OSError as e:
+        return None, f"unreadable {path}: {e}"
+    if not records:
+        return None, f"{path}: no telemetry records (wrong file?)"
+    return records, None
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def fold(records):
+    """Fold serving telemetry into the report body."""
+    submitted = finished = preempts = 0
+    ttfts, latencies, tps = [], [], []
+    new_tokens = 0
+    by_slo = {}
+    peak = {"queue_depth": 0, "active": 0, "blocks_in_use": 0}
+    steps = 0
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "serve_request":
+            if rec.get("event") == "submitted":
+                submitted += 1
+            elif rec.get("event") == "finished":
+                finished += 1
+                new_tokens += int(rec.get("new_tokens", 0))
+                slo = str(rec.get("slo", "standard"))
+                s = by_slo.setdefault(slo, {"finished": 0, "ttft_ms": []})
+                s["finished"] += 1
+                if "ttft_ms" in rec:
+                    ttfts.append(float(rec["ttft_ms"]))
+                    s["ttft_ms"].append(float(rec["ttft_ms"]))
+                if "latency_ms" in rec:
+                    latencies.append(float(rec["latency_ms"]))
+                if "tokens_per_sec" in rec:
+                    tps.append(float(rec["tokens_per_sec"]))
+        elif kind == "serve_preempt":
+            preempts += 1
+        elif kind == "serve_step":
+            steps += 1
+            for key in peak:
+                try:
+                    peak[key] = max(peak[key], int(rec.get(key, 0)))
+                except (TypeError, ValueError):
+                    pass
+
+    ttfts.sort()
+    latencies.sort()
+    for s in by_slo.values():
+        vals = sorted(s.pop("ttft_ms"))
+        s["p50_ttft_ms"] = _pct(vals, 0.50)
+        s["p99_ttft_ms"] = _pct(vals, 0.99)
+    return {
+        "submitted": submitted,
+        "finished": finished,
+        "new_tokens": new_tokens,
+        "preemptions": preempts,
+        "preemption_rate": round(preempts / finished, 4) if finished else 0.0,
+        "p50_ttft_ms": _pct(ttfts, 0.50),
+        "p99_ttft_ms": _pct(ttfts, 0.99),
+        "p50_latency_ms": _pct(latencies, 0.50),
+        "p99_latency_ms": _pct(latencies, 0.99),
+        "mean_tokens_per_sec_per_req": (round(sum(tps) / len(tps), 2)
+                                        if tps else None),
+        "by_slo": by_slo,
+        "gauge_steps": steps,
+        "peaks": peak,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="ServingEngine SLO report over telemetry JSONL")
+    ap.add_argument("path", help="telemetry JSONL file")
+    ap.add_argument("--p99-ttft-ms", type=float, default=None,
+                    help="fail (exit 1) if p99 TTFT exceeds this bound")
+    ap.add_argument("--max-preemption-rate", type=float, default=None,
+                    help="fail (exit 1) if preemptions/finished exceeds this")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    records, err = load_records(args.path)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+    report = {"path": args.path, **fold(records)}
+    if not (report["submitted"] or report["finished"]
+            or report["gauge_steps"]):
+        print(json.dumps({"error": f"{args.path}: no serving records"}),
+              file=sys.stderr)
+        return 2
+
+    gates = {}
+    if args.p99_ttft_ms is not None:
+        val = report["p99_ttft_ms"]
+        gates["p99_ttft_ms"] = {
+            "limit": args.p99_ttft_ms,
+            "value": val,
+            "ok": val is not None and val <= args.p99_ttft_ms,
+        }
+    if args.max_preemption_rate is not None:
+        gates["max_preemption_rate"] = {
+            "limit": args.max_preemption_rate,
+            "value": report["preemption_rate"],
+            "ok": report["preemption_rate"] <= args.max_preemption_rate,
+        }
+    report["gates"] = gates
+    report["ok"] = all(g["ok"] for g in gates.values())
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
