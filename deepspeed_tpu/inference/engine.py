@@ -132,6 +132,10 @@ class InferenceEngine:
         # must evict old programs, not grow device memory without limit)
         self._generate_fns: "OrderedDict[Any, Callable]" = OrderedDict()
         self._forward_fns: "OrderedDict[bool, Callable]" = OrderedDict()
+        # input shapes traced into each forward jit since its last clear —
+        # lets forward() evict lazily (only when a NEW shape would push the
+        # inner cache past the cap) instead of dropping warm programs
+        self._forward_seen: "dict[bool, set]" = {}
         self.program_cache_evictions = 0
         self._bucketed_generate = (
             hasattr(self.module, "generate")
@@ -228,11 +232,19 @@ class InferenceEngine:
 
             fn = jax.jit(fwd) if use_mask else jax.jit(lambda p, i: fwd(p, i))
             self._cache_put(self._forward_fns, use_mask, fn, "forward")
+            self._forward_seen[use_mask] = set()
         # one jit holds one program per input shape; keep that inner cache
-        # bounded too (clear_cache drops all traces — rare, counted)
-        if fn._cache_size() >= max(1, int(self._config.program_cache_size)):
-            fn.clear_cache()
-            self._program_evicted("forward_shapes", use_mask)
+        # bounded too, but evict LAZILY: only a call that would trace a NEW
+        # shape past the cap clears it — a steady-state workload sitting at
+        # exactly the cap keeps replaying its warm programs
+        seen = self._forward_seen.setdefault(use_mask, set())
+        shape_key = (tuple(input_ids.shape), str(input_ids.dtype))
+        if shape_key not in seen:
+            if len(seen) >= max(1, int(self._config.program_cache_size)):
+                fn.clear_cache()
+                seen.clear()
+                self._program_evicted("forward_shapes", use_mask)
+            seen.add(shape_key)
         t0 = time.perf_counter()
         with self._span("inference.forward", batch=int(input_ids.shape[0]),
                         seq=int(input_ids.shape[1]), masked=use_mask):

@@ -6,10 +6,15 @@ KV-cache workspace (``inference_context.h``).  Round 1 shipped a plain-jnp
 full-cache attention that reads all ``max_len`` positions every step; this
 kernel reads ONLY the ``pos + S_q`` valid positions:
 
-* ``pos`` arrives via scalar prefetch, and the kernel loop has a
-  *data-dependent* trip count ``ceil((pos+S_q)/bk)`` — invalid cache blocks
-  are neither DMA'd nor computed (decode is HBM-bound; at pos ≪ max_len
-  this is the whole win).
+* ``pos`` arrives via scalar prefetch; the kernel loop runs a STATIC trip
+  count (``T/bk``, known at compile time) and predicates each iteration's
+  whole copy+compute block on ``j < ceil((pos+S_q)/bk)`` — invalid cache
+  blocks are neither DMA'd nor computed (decode is HBM-bound; at
+  pos ≪ max_len this is the whole win).  The earlier revision bounded the
+  ``fori_loop`` itself by the data-dependent count, which wedged a v5e on
+  first hardware contact; the static bound removes that mechanism, and
+  ``start()``/``wait()`` are paired inside the same predicated branch so
+  the DMA semaphores stay balanced on every control path.
 * K/V stay in HBM (``MemorySpace.ANY``); each valid block is staged into a
   VMEM scratch buffer with an explicit ``make_async_copy`` keyed by the
   dynamic block index.
@@ -71,17 +76,25 @@ def _paged_kernel_enabled() -> bool:
 
 
 def _decode_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
-                   sem_k, sem_v, *, scale, bk, Sq, H):
+                   sem_k, sem_v, *, scale, bk, Sq, H, nk_max):
     """Grid (B,): ONE [bk, H, D] DMA per cache block serves every head
     (batched dot_general over the head dim) — the per-(b, h) grid of the
     round-4 kernel both re-streamed the cache H times and sliced the
-    tiled H dim to 1, which Mosaic rejects on hardware."""
+    tiled H dim to 1, which Mosaic rejects on hardware.
+
+    The loop bound is STATIC (``nk_max = T // bk``): the round-5 kernel
+    bounded the fori_loop by the data-dependent live-block count, and that
+    dynamically-bounded DMA sequence wedged a v5e on first hardware
+    contact.  Here every iteration instead predicates its copy+compute
+    block on ``j < nk`` via ``lax.cond`` — dead blocks cost no HBM traffic
+    and no MXU work, and both DMAs start AND wait inside the same branch,
+    so semaphores stay balanced whichever way the predicate resolves."""
     b = pl.program_id(0)
     pos = pos_ref[0]
     q = q_ref[0]                                  # [Sq, H, D], storage dtype
-    nk = (pos + Sq + bk - 1) // bk                # data-dependent trip count
+    nk = (pos + Sq + bk - 1) // bk                # live (DMA'd) block count
 
-    def body(j, carry):
+    def live(j, carry):
         m, l, acc = carry                         # [H,Sq,1] [H,Sq,1] [H,Sq,D]
         cp_k = pltpu.make_async_copy(k_hbm.at[b, pl.ds(j * bk, bk), :, :],
                                      k_buf, sem_k)
@@ -110,11 +123,14 @@ def _decode_kernel(pos_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
+    def body(j, carry):
+        return jax.lax.cond(j < nk, lambda c: live(j, c), lambda c: c, carry)
+
     D = q.shape[-1]
     m0 = jnp.full((H, Sq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((H, Sq, 1), jnp.float32)
     a0 = jnp.zeros((H, Sq, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(0, nk_max, body, (m0, l0, a0))
     out = acc / jnp.maximum(l, 1e-30)             # [H, Sq, D]
     o_ref[0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
 
@@ -140,7 +156,8 @@ def _decode_call(q, ck, cv, pos, *, bk):
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bk=bk, Sq=Sq, H=H),
+        functools.partial(_decode_kernel, scale=scale, bk=bk, Sq=Sq, H=H,
+                          nk_max=ck.shape[1] // bk),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Sq, H, D), q.dtype),
         interpret=_interpret(),
@@ -215,13 +232,21 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
     """Grid (B,): per row, DMA ONLY the ``ceil((len+Sq)/bs)`` live physical
     blocks through the block table (scalar-prefetched, so the dynamic block
     index is known before the DMA is issued) — the same one-copy-serves-
-    every-head layout as ``_decode_kernel``."""
+    every-head layout as ``_decode_kernel``.
+
+    Like ``_decode_kernel``, the loop bound is STATIC (``MB``, the block
+    table's row width) and liveness is a per-iteration ``lax.cond``
+    predicate — no dynamically-bounded DMA sequence, and ``j`` can never
+    reach ``MB``, so the table read ``tbl_ref[b*MB + j]`` is in-bounds by
+    construction even when a padded prefill chunk pushes
+    ``len + Sq`` past ``MB * bs`` (the causal mask already discards the
+    padded tail's scores)."""
     b = pl.program_id(0)
     seq_len = len_ref[b]
     q = q_ref[0]                                  # [Sq, H, D]
-    nk = (seq_len + Sq + bs - 1) // bs            # data-dependent trip count
+    nk = (seq_len + Sq + bs - 1) // bs            # live (DMA'd) block count
 
-    def body(j, carry):
+    def live(j, carry):
         m, l, acc = carry
         phys = tbl_ref[b * MB + j]                # logical block j -> physical
         cp_k = pltpu.make_async_copy(k_hbm.at[phys], k_buf, sem_k)
@@ -246,11 +271,14 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf,
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
+    def body(j, carry):
+        return jax.lax.cond(j < nk, lambda c: live(j, c), lambda c: c, carry)
+
     D = q.shape[-1]
     m0 = jnp.full((H, Sq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((H, Sq, 1), jnp.float32)
     a0 = jnp.zeros((H, Sq, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(0, MB, body, (m0, l0, a0))
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
 

@@ -35,8 +35,8 @@ import numpy as np
 from deepspeed_tpu.serving.config import DeepSpeedServingConfig
 from deepspeed_tpu.serving.kv_cache import (ArenaExhausted, PagedKVAllocator,
                                             init_arena)
-from deepspeed_tpu.serving.scheduler import (DECODE, FINISHED, Request,
-                                             ServingScheduler)
+from deepspeed_tpu.serving.scheduler import (DECODE, FINISHED, SLO_PRIORITY,
+                                             Request, ServingScheduler)
 from deepspeed_tpu.telemetry.tracing import get_global_tracer
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -167,6 +167,11 @@ class ServingEngine:
         if temperature:
             raise NotImplementedError(
                 "serving is greedy-only in this PR (temperature=0)")
+        if slo not in SLO_PRIORITY:
+            raise ValueError(
+                f"unknown slo class {slo!r}; expected one of "
+                f"{sorted(SLO_PRIORITY)} (a typo here would otherwise "
+                "silently demote the request to 'standard')")
         cfg, mcfg = self._config, self.module.cfg
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         assert prompt, "empty prompt"
@@ -308,11 +313,14 @@ class ServingEngine:
 
 def init_serving(model=None, config=None, **kwargs):
     """Module-level helper in the ``deepspeed.init_inference`` style: merge
-    a ``{"serving": {...}}`` (or flat) config dict + kwargs."""
+    a ``{"serving": {...}}`` (or flat) config dict + kwargs.  The nested
+    form is collapsed FIRST and kwargs applied after, so engine kwargs
+    (``params=``, ``telemetry=``, ...) are never silently discarded by a
+    full ds_config — and explicit kwargs always win over config keys."""
     cfg_dict = dict(config or {})
-    cfg_dict.update(kwargs)
     if "serving" in cfg_dict:
         cfg_dict = dict(cfg_dict["serving"])
+    cfg_dict.update(kwargs)
     params = cfg_dict.pop("params", None)
     telemetry = cfg_dict.pop("telemetry", None)
     tracer = cfg_dict.pop("tracer", None)

@@ -72,6 +72,27 @@ def test_forward_keyed_on_mask_presence():
     assert not np.allclose(np.asarray(plain)[:, :4], np.asarray(padded)[:, :4])
 
 
+def test_forward_inner_cache_evicts_lazily():
+    """A steady-state workload at exactly the cap must keep replaying its
+    warm programs: the inner jit cache is only cleared when a NEW shape
+    would push it past the cap, never on a hit."""
+    model = Bert(bert_config("tiny", dtype=jnp.float32))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32", "program_cache_size": 2})
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(1, 100, (2, 8)), jnp.int32)
+    b = jnp.asarray(rng.integers(1, 100, (2, 16)), jnp.int32)
+    for ids in (a, b, a, b, a, b):        # saturate the cap, then cycle
+        engine.forward(ids)
+    fn = engine._forward_fns[False]
+    assert fn._cache_size() == 2          # both programs still warm
+    assert engine.program_cache_evictions == 0
+    c = jnp.asarray(rng.integers(1, 100, (2, 24)), jnp.int32)
+    engine.forward(c)                     # third shape: NOW it clears
+    assert engine.program_cache_evictions == 1
+    assert fn._cache_size() == 1
+
+
 def test_forward_mask_rejected_when_model_lacks_it():
     engine = gpt_engine()
     ids = jnp.asarray([[1, 2, 3]], jnp.int32)

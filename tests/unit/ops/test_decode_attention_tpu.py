@@ -1,0 +1,17 @@
+"""TPU-hardware decode + paged attention parity and sustained-decode soak
+(interpret=False).
+
+The test session runs on the virtual CPU mesh (tests/conftest.py), so the
+hardware check runs in a child process with the default backend; it is
+skipped when the machine has no TPU.  This is the in-suite hook for the
+default-on graduation gate (README § Pallas decode kernel status): the
+soak inside ``tools/decode_bench.py`` is what distinguishes the fixed
+static-trip-count DMA loop from the round-5 kernel that wedged a v5e —
+a wedge shows up here as a post-claim hang, which ``run_tpu_tool``
+reports as a FAILURE, not a skip."""
+
+from tests.unit.common import run_tpu_tool
+
+
+def test_decode_attention_parity_and_soak_on_tpu():
+    run_tpu_tool("decode_bench.py")

@@ -93,6 +93,24 @@ def test_pallas_kernel_parity(monkeypatch, Sq, length):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("Sq,length", [(4, 62), (8, 57)])
+def test_pallas_kernel_padded_chunk_overhang(monkeypatch, Sq, length):
+    """A padded prefill chunk can push ``length + Sq`` past the table
+    capacity ``MB*BS`` (prefill_chunk not dividing the tail): the kernel's
+    static MB-bound loop must keep every ``tbl_ref`` read inside the row —
+    the old data-dependent trip count ran ``ceil((length+Sq)/BS) > MB``
+    iterations and gathered a garbage physical block id — and still match
+    the reference exactly."""
+    monkeypatch.setenv("DST_PALLAS_PAGED", "1")
+    q, kp, vp, tables, lengths = make_paged(Sq=Sq, length=length, seed=5)
+    MB, BS = tables.shape[1], kp.shape[1]
+    assert length + Sq > MB * BS          # the overhang this test is about
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    out = paged_attention(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_dispatch_falls_back_on_bias_and_gqa(monkeypatch):
     """Unsupported kernel shapes (ALiBi bias, grouped heads) must route to
     the reference even when the kernel is forced on."""

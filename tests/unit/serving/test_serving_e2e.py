@@ -135,8 +135,14 @@ def test_serving_telemetry_records(tiny_model):
 
 
 def test_init_serving_config_path(tiny_model):
+    """The nested ``{"serving": {...}}`` form must NOT swallow engine
+    kwargs: the engine has to serve the trained params passed alongside
+    it.  Params come from a non-default seed here — with seed-0 params the
+    old collapse-after-merge bug was invisible, because the silently
+    re-initialized model happened to equal the fixture."""
     import deepspeed_tpu
-    model, params = tiny_model
+    model, _ = tiny_model
+    params = model.init_params(jax.random.PRNGKey(42))
     eng = deepspeed_tpu.init_serving(
         model=model,
         config={"serving": {"block_size": 8, "num_blocks": 32,
@@ -147,6 +153,13 @@ def test_init_serving_config_path(tiny_model):
     assert eng._config.block_size == 8 and eng._config.max_batch_size == 2
     out = eng.submit([1, 2, 3], max_new_tokens=4).result()
     assert out == sequential_reference(model, params, [1, 2, 3], 4)
+    # explicit kwargs also override keys inside the nested dict
+    eng2 = deepspeed_tpu.init_serving(
+        model=model, config={"serving": {"block_size": 8, "num_blocks": 32,
+                                         "max_batch_size": 2,
+                                         "dtype": "float32"}},
+        params=params, max_batch_size=4)
+    assert eng2._config.max_batch_size == 4
 
 
 def test_serving_config_in_ds_config():
@@ -168,3 +181,7 @@ def test_submit_rejects_oversized_and_sampled(tiny_model):
         eng.submit([1, 2], max_new_tokens=1000)      # past n_positions
     with pytest.raises(NotImplementedError):
         eng.submit([1, 2], max_new_tokens=4, temperature=0.7)
+    with pytest.raises(ValueError):
+        # a typo'd SLO class must fail fast, not silently demote the
+        # request to 'standard' priority
+        eng.submit([1, 2], max_new_tokens=4, slo="rt")
