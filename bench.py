@@ -251,6 +251,64 @@ def bench_decode(dtype=None):
     return rec
 
 
+def _zero3_overlap_fractions():
+    """Overlap fraction of the ZeRO-3 collective schedule, measured
+    through the real telemetry pipeline: a tiny scan GPT runs one traced
+    step with the layered stage-3 step (``overlap_comm`` on) and one with
+    the bulk step, the engine emits its schedule lanes anchored in the
+    measured fwd span, ``telemetry_close`` exports the rank trace, and
+    ``tools/trace_merge.compute_overlap`` reads the fraction back off the
+    merged timeline — the same walkthrough README § "Compute–communication
+    overlap" documents.  Returns {"layered": f, "bulk": f} (None entries
+    when a path yields no trace)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools import trace_merge
+
+    n_dev = jax.device_count()
+    ids = np.random.default_rng(0).integers(
+        0, 128, (n_dev, 32)).astype(np.int32)
+    out = {}
+    # bulk comparator needs an active compressed-collective config (that
+    # is the path that emits the bulk schedule lanes) — qwZ int8 here
+    for key, zero_over in (
+            ("layered", {"overlap_comm": True}),
+            ("bulk", {"overlap_comm": False, "zero_quantized_weights": True})):
+        with tempfile.TemporaryDirectory() as td:
+            model = GPT(GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                                  n_layer=4, n_head=4, dtype=jnp.float32,
+                                  attn_impl="reference"))
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model, model_parameters=model.init_params(jax.random.key(0)),
+                config={"train_micro_batch_size_per_gpu": 1,
+                        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                        "zero_optimization": {"stage": 3, **zero_over},
+                        "steps_per_print": 10 ** 9,
+                        "telemetry": {"enabled": True, "tracing": True,
+                                      "trace_dir": td,
+                                      "watchdog_enabled": False}},
+                seed=7)
+            loss = engine.forward(ids, ids)
+            engine.backward(loss)
+            engine.step()
+            engine.telemetry_close()
+            path = os.path.join(td, "trace_rank0.json")
+            try:
+                merged = trace_merge.merge_traces(
+                    [trace_merge.load_rank_trace(path)])
+                ov = trace_merge.compute_overlap(merged["traceEvents"])
+            except (trace_merge.TraceFormatError, OSError):
+                ov = None
+            out[key] = round(ov["fraction"], 3) if ov else None
+    return out
+
+
 def bench_comm():
     """Collective wire volume: the ZeRO-3 exchange pair (parameter
     all-gather + gradient reduce-scatter) fp32 vs compressed, on one
@@ -258,7 +316,11 @@ def bench_comm():
     reduction — exactly what the comms logger / ``tools/comm_audit.py``
     report in training — with the measured step times alongside (on CPU
     meshes the quantized path is *slower*; the win is wire bytes, which
-    is what an ICI/DCN-bound real topology converts into time)."""
+    is what an ICI/DCN-bound real topology converts into time).  The
+    ``overlap_fraction`` column is the layered stage-3 schedule's
+    collective-concurrent-with-compute fraction off a traced run
+    (``overlap_fraction_bulk`` is the same readout for the bulk step —
+    expected ~0)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -332,6 +394,12 @@ def bench_comm():
         "fp32_reduce_scatter_ms": round(t["rs_fp32"] * 1e3, 3),
         "qgz_reduce_scatter_ms": round(t["rs_qgz"] * 1e3, 3),
     }
+    try:
+        fractions = _zero3_overlap_fractions()
+        rec["overlap_fraction"] = fractions["layered"]
+        rec["overlap_fraction_bulk"] = fractions["bulk"]
+    except Exception as e:   # the volume headline must survive a trace miss
+        rec["overlap_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     print(json.dumps(rec))
     return rec
 
@@ -414,25 +482,66 @@ def _detail_path():
     return os.path.join(here, f"BENCH_DETAIL_r{max(rounds, default=0) + 1:02d}.json")
 
 
-def _probe_backend(timeout_s: int = 240):
+def _bench_recorder():
+    """FlightRecorder writing next to the detail artifacts (no engine —
+    the probe/rung stalls happen before or around engine construction)."""
+    from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
+    here = os.path.dirname(os.path.abspath(__file__))
+    return FlightRecorder(os.environ.get(
+        "BENCH_FLIGHT_DIR", os.path.join(here, "bench_flight")))
+
+
+def _probe_backend(timeout_s: int = None, retries: int = None):
     """Touch ``jax.devices()`` in a CHILD process first: a wedged remote
     TPU pool hangs the claim indefinitely inside a C call, which no
     in-process timeout can interrupt — probing in a subprocess turns an
     unbounded hang into a bounded, parseable failure for the driver.
-    Returns None on success, else a diagnosis string (timeout vs the
-    child's actual stderr for fast init errors)."""
+
+    The probe runs under the hang watchdog with a flight-recorder dump:
+    a wedged pool leaves thread stacks + the stall reason on disk
+    (BENCH_FLIGHT_DIR, default ./bench_flight) instead of a silent
+    multi-minute stall, then retries a bounded number of times
+    (BENCH_PROBE_RETRIES, default 1 retry) — remote tunnels often come
+    back between attempts.  Returns None on success, else the LAST
+    attempt's diagnosis string (timeout vs the child's actual stderr for
+    fast init errors)."""
     import subprocess
+    from deepspeed_tpu.telemetry.watchdog import HangWatchdog
+
+    timeout_s = timeout_s or int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90"))
+    retries = (retries if retries is not None
+               else int(os.environ.get("BENCH_PROBE_RETRIES", "1")))
+    recorder = _bench_recorder()
+    # fire before subprocess.run's own timeout so the dump captures the
+    # still-stalled state (not the post-kill cleanup)
+    watchdog = HangWatchdog(timeout_s=max(1.0, 0.75 * timeout_s),
+                            on_stall=recorder.on_stall)
+    watchdog.start()
+    err = None
     try:
-        p = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return (f"jax.devices() did not complete in {timeout_s}s — remote "
-                "TPU pool/tunnel unreachable or wedged")
-    if p.returncode != 0:
-        tail = (p.stderr or "").strip().splitlines()[-3:]
-        return f"backend init failed (rc={p.returncode}): " + " | ".join(tail)
-    return None
+        for attempt in range(1 + max(0, retries)):
+            tag = f"backend probe (attempt {attempt + 1}/{1 + retries})"
+            watchdog.arm(tag)
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-c", "import jax; jax.devices()"],
+                    timeout=timeout_s, capture_output=True, text=True)
+            except subprocess.TimeoutExpired:
+                err = (f"jax.devices() did not complete in {timeout_s}s "
+                       f"({tag}) — remote TPU pool/tunnel unreachable or "
+                       "wedged")
+                continue
+            finally:
+                watchdog.disarm()
+            if p.returncode != 0:
+                tail = (p.stderr or "").strip().splitlines()[-3:]
+                err = (f"backend init failed (rc={p.returncode}, {tag}): "
+                       + " | ".join(tail))
+                continue
+            return None
+        return err
+    finally:
+        watchdog.stop()
 
 
 def _latest_detail():
@@ -487,10 +596,29 @@ def main():
                            "captured numbers"}))
         sys.exit(2)
     mode = os.environ.get("BENCH_MODE", "all")
+    # per-rung stall watchdog: a rung that wedges inside a collective
+    # can't be interrupted in-process, but it CAN leave a flight-recorder
+    # dump (thread stacks, stall reason) so the silent hang the driver
+    # eventually kills is diagnosable post-mortem
+    from deepspeed_tpu.telemetry.watchdog import HangWatchdog
+    rung_timeout = float(os.environ.get("BENCH_RUNG_TIMEOUT_S", "600"))
+    watchdog = HangWatchdog(timeout_s=rung_timeout,
+                            on_stall=_bench_recorder().on_stall)
+    watchdog.start()
+
+    def run_rung(name, fn):
+        watchdog.arm(f"bench rung '{name}'")
+        try:
+            return fn()
+        finally:
+            watchdog.disarm()
+
     if mode != "all":
         # unknown modes raise (a typo must not silently run the full suite)
-        {"train": bench_train, "bert": bench_bert, "decode": bench_decode,
-         "comm": bench_comm, "serve": bench_serve}[mode]()
+        run_rung(mode, {"train": bench_train, "bert": bench_bert,
+                        "decode": bench_decode, "comm": bench_comm,
+                        "serve": bench_serve}[mode])
+        watchdog.stop()
         return
     # default: the full rung set — decode (bf16 + int8 weight-only), BERT
     # MLM, then the headline train line LAST (the driver parses the final
@@ -503,11 +631,12 @@ def main():
                      ("serve", bench_serve),
                      ("train", bench_train)):
         try:
-            detail[name] = fn()
+            detail[name] = run_rung(name, fn)
         except Exception as e:   # a broken rung must not kill the headline
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
             print(json.dumps({"metric": f"{name} FAILED",
                               "error": str(e)[:200]}), file=sys.stderr)
+    watchdog.stop()
     if all(isinstance(v, dict) and "value" in v
            for k, v in detail.items() if k.startswith("decode")):
         detail["int8_vs_bf16_uplift"] = round(

@@ -6,6 +6,10 @@ topology-aware collectives that cut ZeRO-3 wire volume —
 * ``qwz``  — quantized weight all-gather
 * ``qgz``  — hierarchical quantized gradient reduce-scatter
 * ``hpz``  — secondary intra-host weight shard (slow-axis-free regathers)
+
+``layered`` composes the three into per-block slice gathers with
+reduce-scatter backward rules for the overlapped stage-3 step (the scan
+carries a prefetch ring; collectives hide under block matmuls).
 """
 
 from deepspeed_tpu.comm.compression.core import (  # noqa: F401
@@ -28,6 +32,7 @@ from deepspeed_tpu.comm.compression.core import (  # noqa: F401
 from deepspeed_tpu.comm.compression.hpz import (  # noqa: F401
     fast_regather,
     hierarchical_gather,
+    slow_gather_secondary,
 )
 from deepspeed_tpu.comm.compression.qgz import (  # noqa: F401
     hierarchical_reduce_scatter,

@@ -53,6 +53,41 @@ def fast_regather(secondary: jax.Array, dim: int, fast_axis: str,
                          + shape[2 + dim:])
 
 
+def slow_gather_secondary(x: jax.Array, dim: int, axes: Sequence[str],
+                          quantize_bits: Optional[int] = None,
+                          block_size: int = 256,
+                          secondary_dtype=jnp.bfloat16) -> jax.Array:
+    """Just the slow-axis hop: gather this device's primary shard into the
+    fast-axis-only secondary shard (dim becomes W_slow interleaved stripes
+    of the local chunk, in ``secondary_dtype``).
+
+    Shared by :func:`hierarchical_gather` (bulk refresh) and the layered
+    step's standalone secondary-refresh program, which builds the stacked
+    secondary once per parameter-freshness window while the per-block
+    ``fast_regather`` runs inside the scan (``compression/layered.py``).
+    The slow hop treats every other dim — including a leading stacked
+    layer dim — as batch, so a slice of the stacked secondary equals the
+    secondary of the slice.
+    """
+    from deepspeed_tpu.comm.comm import compressed_op_span
+
+    slow = axes[0]
+    w_slow = mesh_lib.manual_axis_size(slow)
+    m = x.size
+    if quantize_bits is not None:
+        return qwz.quantized_all_gather(
+            x, (slow,), dim=dim, bits=quantize_bits, block_size=block_size,
+            out_dtype=secondary_dtype)
+    wire = qwz.logical_bytes(m, w_slow, jnp.dtype(secondary_dtype).itemsize)
+    with compressed_op_span(
+            "hpz_secondary_gather",
+            logical_bytes=qwz.logical_bytes(m, w_slow),
+            wire_bytes=wire, group=(slow,)):
+        return qwz.merge_at_dim(
+            lax.all_gather(x.astype(secondary_dtype), slow,
+                           axis=0, tiled=False), dim)
+
+
 def hierarchical_gather(x: jax.Array, dim: int, axes: Sequence[str],
                         quantize_bits: Optional[int] = None,
                         block_size: int = 256,
@@ -76,22 +111,11 @@ def hierarchical_gather(x: jax.Array, dim: int, axes: Sequence[str],
 
     slow, fast = axes
     w_slow = mesh_lib.manual_axis_size(slow)
-    m = x.size
 
-    if quantize_bits is not None:
-        stripes = qwz.quantized_all_gather(
-            x, (slow,), dim=dim, bits=quantize_bits, block_size=block_size,
-            out_dtype=secondary_dtype)
-    else:
-        wire = qwz.logical_bytes(m, w_slow, jnp.dtype(secondary_dtype).itemsize)
-        with compressed_op_span(
-                "hpz_secondary_gather",
-                logical_bytes=qwz.logical_bytes(m, w_slow),
-                wire_bytes=wire, group=(slow,)):
-            stripes = qwz.merge_at_dim(
-                lax.all_gather(x.astype(secondary_dtype), slow,
-                               axis=0, tiled=False), dim)
-    secondary = stripes  # dim now Ws*g: the fast-axis shard of the full dim
+    # dim now Ws*g: the fast-axis shard of the full dim
+    secondary = slow_gather_secondary(x, dim, axes, quantize_bits=quantize_bits,
+                                      block_size=block_size,
+                                      secondary_dtype=secondary_dtype)
 
     def _fast(sec):
         w_fast = mesh_lib.manual_axis_size(fast)

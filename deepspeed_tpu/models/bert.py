@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from deepspeed_tpu.comm.compression import layered as zero_layered
 from deepspeed_tpu.models.gpt import (_activation, _dense_init, _dropout,
                                       layer_norm)
 from deepspeed_tpu.parallel import mesh as mesh_lib
@@ -262,11 +263,32 @@ def bert_encoder_stack(cfg: BertConfig, params: Dict, x: Array,
         rngs = (jax.random.split(jax.random.fold_in(rng, 7), L) if use_rngs
                 else jnp.zeros((L, 2), jnp.uint32))
 
-        def scan_body(x, layer):
-            p, r = layer
-            return body(p, x, rng=r if use_rngs else None), None
-        with jax.named_scope("blocks"):
-            x, _ = jax.lax.scan(scan_body, x, (params["blocks"], rngs))
+        pf = zero_layered.current_prefetch()
+        if pf is not None:
+            # layered ZeRO-3: blocks stay sharded; gather one slice per
+            # iteration through the prefetch ring (gather i+depth while i
+            # computes) so XLA overlaps the collective with the block matmuls
+            blocks = params["blocks"]
+            depth = pf.clamped_depth(L)
+            ring = tuple(pf.gather_block(blocks, jnp.int32(k))
+                         for k in range(depth))
+            idxs = jnp.arange(L, dtype=jnp.int32)
+
+            def scan_body(carry, layer):
+                x, ring = carry
+                nxt = pf.gather_block(blocks, jnp.minimum(layer["i"] + depth,
+                                                          L - 1))
+                x = body(ring[0], x, rng=layer["r"] if use_rngs else None)
+                return (x, ring[1:] + (nxt,)), None
+            with jax.named_scope("blocks"):
+                (x, _), _ = jax.lax.scan(scan_body, (x, ring),
+                                         {"r": rngs, "i": idxs})
+        else:
+            def scan_body(x, layer):
+                p, r = layer
+                return body(p, x, rng=r if use_rngs else None), None
+            with jax.named_scope("blocks"):
+                x, _ = jax.lax.scan(scan_body, x, (params["blocks"], rngs))
     else:
         for i in range(cfg.num_hidden_layers):
             r = jax.random.fold_in(rng, i) if use_rngs else None
@@ -319,6 +341,10 @@ def bert_mlm_loss(cfg: BertConfig, params: Dict, input_ids: Array,
 class Bert:
     """Engine-compatible model object (callable convention
     ``fn(params, batch, rng, train) -> loss``)."""
+
+    # the encoder scan consumes per-block slices through the layered ZeRO-3
+    # prefetch context (engine gates the overlapped step on this attribute)
+    supports_layered_zero3 = True
 
     def __init__(self, cfg: BertConfig):
         self.cfg = cfg

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from deepspeed_tpu.comm.compression import layered as zero_layered
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
 Array = jax.Array
@@ -593,25 +594,61 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
         use_rngs = rng is not None and train
         rngs = (jax.random.split(jax.random.fold_in(rng, 7), cfg.n_layer)
                 if use_rngs else jnp.zeros((cfg.n_layer, 2), jnp.uint32))
-        xs = {"p": params["blocks"], "r": rngs}
+        pf = zero_layered.current_prefetch()
+        xs = {"r": rngs}
+        if pf is None:
+            xs["p"] = params["blocks"]
+        else:
+            xs["i"] = jnp.arange(cfg.n_layer, dtype=jnp.int32)
         if ltd_on:
             xs["idx"] = ltd_idx
         if pld_on:
             xs["keep"] = pld_keep
 
-        def scan_body(carry, layer):
-            x, aux_sum = carry
-            r = layer["r"] if use_rngs else None
-            run = lambda xx: apply_block(layer["p"], xx, r, layer.get("idx"))
-            if pld_on:   # lax.cond: a dropped block really skips its FLOPs
-                x, aux = jax.lax.cond(layer["keep"], run,
-                                      lambda xx: (xx, zero_aux), x)
-            else:
-                x, aux = run(x)
-            return (x, aux_sum + aux), None
+        if pf is not None:
+            # Layered ZeRO-3: params["blocks"] are still SHARDED here —
+            # the carry holds a ring of `depth` already-gathered block
+            # slices, and each iteration issues block i+depth's gather
+            # (independent of block i's compute, so XLA's async collective
+            # start/done hides it under the matmuls) before consuming the
+            # ring head.  The gathers' custom-vjp backward reduce-scatters
+            # each block's grads as its backward slice completes.
+            blocks = params["blocks"]
+            depth = pf.clamped_depth(cfg.n_layer)
+            ring = tuple(pf.gather_block(blocks, jnp.int32(k))
+                         for k in range(depth))
 
-        with jax.named_scope("blocks"):
-            (x, aux_total), _ = jax.lax.scan(scan_body, (x, zero_aux), xs)
+            def scan_body(carry, layer):
+                (x, aux_sum), ring = carry
+                nxt = pf.gather_block(
+                    blocks, jnp.minimum(layer["i"] + depth, cfg.n_layer - 1))
+                p = ring[0]
+                r = layer["r"] if use_rngs else None
+                run = lambda xx: apply_block(p, xx, r, layer.get("idx"))
+                if pld_on:
+                    x, aux = jax.lax.cond(layer["keep"], run,
+                                          lambda xx: (xx, zero_aux), x)
+                else:
+                    x, aux = run(x)
+                return ((x, aux_sum + aux), ring[1:] + (nxt,)), None
+
+            with jax.named_scope("blocks"):
+                ((x, aux_total), _), _ = jax.lax.scan(
+                    scan_body, ((x, zero_aux), ring), xs)
+        else:
+            def scan_body(carry, layer):
+                x, aux_sum = carry
+                r = layer["r"] if use_rngs else None
+                run = lambda xx: apply_block(layer["p"], xx, r, layer.get("idx"))
+                if pld_on:   # lax.cond: a dropped block really skips its FLOPs
+                    x, aux = jax.lax.cond(layer["keep"], run,
+                                          lambda xx: (xx, zero_aux), x)
+                else:
+                    x, aux = run(x)
+                return (x, aux_sum + aux), None
+
+            with jax.named_scope("blocks"):
+                (x, aux_total), _ = jax.lax.scan(scan_body, (x, zero_aux), xs)
     else:
         for i in range(cfg.n_layer):
             r = jax.random.fold_in(rng, i) if (rng is not None and train) else None
@@ -1152,6 +1189,10 @@ def gpt_pipeline_module(cfg: GPTConfig, num_stages: int, tied_embedding: bool = 
 class GPT:
     """Engine-compatible model object (``.apply``-free callable convention:
     ``fn(params, batch, rng, train) -> loss``) with ``init_params``."""
+
+    # the scan branch consumes per-block slices through the layered ZeRO-3
+    # prefetch context (engine gates the overlapped step on this attribute)
+    supports_layered_zero3 = True
 
     def __init__(self, cfg: GPTConfig):
         self.cfg = cfg

@@ -61,6 +61,33 @@ from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIM
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
 
+def _layered_rest_gather(x, sec, d, cc, reuse):
+    """Gather one NON-block leaf for the layered step, exactly as the bulk
+    step treats it: exact/qwZ all-gather of the primary shard, or the hpZ
+    fast-axis regather of its (precomputed) secondary shard.  Kept outside
+    ``_build_layered_step`` so the overlap-structure lint can assert the
+    step body itself issues no whole-tree gathers (block leaves must only
+    be gathered slice-wise through ``compression/layered.py``)."""
+    from deepspeed_tpu.comm.compression import hpz as hpz_mod
+    from deepspeed_tpu.comm.compression import qwz
+    axes, sizes = cc["axes"], cc["sizes"]
+    group = axes if len(axes) > 1 else axes[0]
+    if cc["hpz"]:
+        if d is None:
+            return sec.astype(jnp.float32) if reuse else x
+        regather = lambda s: hpz_mod.fast_regather(s, d, axes[1],
+                                                   w_slow=sizes[0])
+        if not reuse:   # refresh keeps the bulk path's remat of the full
+            regather = jax.checkpoint(regather)
+        return regather(sec)
+    if d is None:
+        return x
+    if cc["qw_bits"] is not None:
+        return qwz.quantized_all_gather(x, axes, dim=d, bits=cc["qw_bits"],
+                                        block_size=cc["block"])
+    return jax.lax.all_gather(x, group, axis=d, tiled=True)
+
+
 def split_half_float_double_sparse(tensors):  # parity shim
     return [("dense", tensors)]
 
@@ -798,10 +825,24 @@ class DeepSpeedEngine:
         self._cc_step = None
         self._cc_step_reuse = None
         self._hpz_secondary = None
+        self._layered_step = None
+        self._layered_step_reuse = None
+        self._layered_secondary_prog = None
+        # per-step byte tables are derived from the active config: any
+        # reconfiguration must drop them (satellite fix: these were
+        # previously memoized once per engine and went stale)
+        self._cc_bytes_tables = {}
         qw = bool(getattr(zc, "zero_quantized_weights", False))
         qg = bool(getattr(zc, "zero_quantized_gradients", False))
         hpz_size = int(getattr(zc, "zero_hpz_partition_size", 1))
-        if not (qw or qg or hpz_size > 1):
+        # An *explicit* overlap_comm=true at stage 3 opts into the layered
+        # step (per-block gather/RS inside the scan) — that path runs over
+        # the same explicit-collective machinery, so it activates cc even
+        # with every quantization knob off (pure-exact wire format).
+        overlap_req = (bool(getattr(zc, "overlap_comm", False))
+                       and bool(zc.__dict__.get("overlap_comm_explicit", False))
+                       and zc.stage == 3)
+        if not (qw or qg or hpz_size > 1 or overlap_req):
             return
         if zc.stage < 3:
             log_dist("compressed collectives: zero_quantized_* / hpz need "
@@ -838,11 +879,19 @@ class DeepSpeedEngine:
             "qg_bits": int(zc.zero_quantized_gradients_bits) if qg else None,
             "block": int(zc.zero_quantization_block_size),
             "hpz": hpz,
+            # layered overlap: requested now, capability resolved lazily at
+            # the first forward (needs the materialized params/shardings)
+            "overlap": overlap_req,
+            "exact_only": overlap_req and not (qw or qg or hpz_size > 1),
+            "prefetch_depth": int(getattr(zc, "prefetch_depth", 1)),
+            "layered": None,
+            "n_layer": None,
         }
         log_dist(f"compressed collectives active over axes {axes}: "
                  f"qwZ={'int%d' % self._cc['qw_bits'] if qw else 'off'}, "
                  f"qgZ={'int%d' % self._cc['qg_bits'] if qg else 'off'}, "
-                 f"hpZ={'on' if hpz else 'off'}", ranks=[0])
+                 f"hpZ={'on' if hpz else 'off'}, "
+                 f"overlap={'requested' if overlap_req else 'off'}", ranks=[0])
 
     def _cc_plan(self):
         """Per-leaf: which dim the ZeRO policy sharded over the cc axes
@@ -852,56 +901,74 @@ class DeepSpeedEngine:
         return [zero_gather_dim(s.spec, axes)
                 for s in jax.tree.leaves(self.param_shardings)]
 
-    def _cc_byte_table(self, reuse: bool):
+    def _cc_byte_table(self, reuse: bool, layered: bool = False):
         """op name -> [wire_bytes, logical_bytes] moved per forward call,
         computed from shapes at build time — appended host-side per executed
-        step (in-program spans fire only at trace time)."""
+        step (in-program spans fire only at trace time).
+
+        Layered mode gathers/reduce-scatters block leaves one scan slice at
+        a time: L used slices plus ``prefetch_depth`` ring-warmup/clamped
+        extras (which carry zero cotangents but still move wire bytes).
+        The hpZ stacked secondary is built once per freshness window by the
+        standalone slow-hop program, so its bytes are never slice-scaled.
+        """
         from deepspeed_tpu.comm.compression import qgz, qwz
+        from deepspeed_tpu.runtime.zero.policy import (_path_keys,
+                                                       is_stacked_block_path)
         cc = self._cc
         sizes, world = cc["sizes"], int(np.prod(cc["sizes"]))
         table = {}
 
-        def add(op, wire, logical):
+        def add(op, wire, logical, copies=1):
             w, l = table.setdefault(op, [0, 0])
-            table[op] = [w + wire, l + logical]
+            table[op] = [w + wire * copies, l + logical * copies]
 
-        for p, d in zip(jax.tree.leaves(self.state.params), self._cc_plan()):
+        flat = jax.tree_util.tree_flatten_with_path(self.state.params)[0]
+        for (path, p), d in zip(flat, self._cc_plan()):
             if d is None:
                 continue
             n = int(np.prod(p.shape))
+            copies = 1
+            if layered and is_stacked_block_path(_path_keys(path)):
+                L = int(p.shape[0])
+                n //= L
+                depth = max(1, min(cc["prefetch_depth"], max(1, L - 1)))
+                copies = L + depth
             shard = n // world
             ag_logical = qwz.logical_bytes(shard, world)
             if cc["hpz"]:
                 w0, wf = sizes
                 if not reuse:
-                    slow_wire = (qwz.wire_bytes(shard, w0, cc["qw_bits"], cc["block"])
+                    full_shard = int(np.prod(p.shape)) // world
+                    slow_wire = (qwz.wire_bytes(full_shard, w0, cc["qw_bits"],
+                                                cc["block"])
                                  if cc["qw_bits"] is not None
-                                 else (w0 - 1) * shard * 2)
+                                 else (w0 - 1) * full_shard * 2)
                     add("hpz_secondary_gather", slow_wire,
-                        qwz.logical_bytes(shard, w0))
+                        qwz.logical_bytes(full_shard, w0))
                 add("hpz_fast_all_gather",
                     qwz.logical_bytes(shard * w0, wf, 2),
-                    qwz.logical_bytes(shard * w0, wf))
+                    qwz.logical_bytes(shard * w0, wf), copies)
             elif cc["qw_bits"] is not None:
                 add("qwz_all_gather",
                     qwz.wire_bytes(shard, world, cc["qw_bits"], cc["block"]),
-                    ag_logical)
+                    ag_logical, copies)
             else:
-                add("zero3_all_gather", ag_logical, ag_logical)
+                add("zero3_all_gather", ag_logical, ag_logical, copies)
             rs_op = ("qgz_reduce_scatter" if cc["qg_bits"] is not None
                      else "zero3_reduce_scatter")
             add(rs_op, qgz.wire_bytes(n, sizes, cc["qg_bits"], cc["block"]),
-                qgz.logical_bytes(n, world))
+                qgz.logical_bytes(n, world), copies)
         return table
 
-    def _append_cc_bytes(self, reuse: bool):
+    def _append_cc_bytes(self, reuse: bool, layered: bool = False):
         if self.comms_logger is None:
             return
-        key = "_cc_bytes_reuse" if reuse else "_cc_bytes_refresh"
-        table = getattr(self, key, None)
+        key = (bool(reuse), bool(layered))
+        table = self._cc_bytes_tables.get(key)
         if table is None:
-            table = self._cc_byte_table(reuse)
-            setattr(self, key, table)
+            table = self._cc_bytes_tables[key] = self._cc_byte_table(
+                reuse, layered)
         for op, (wire, logical) in table.items():
             self.comms_logger.append(op, wire, logical_size=logical)
 
@@ -1007,6 +1074,225 @@ class DeepSpeedEngine:
             out_specs=out_specs, check_vma=False)
         return jax.jit(fn)
 
+    # -- Layered ZeRO-3: per-block gather/RS inside the scan ------------- #
+    def _layered_capable(self) -> bool:
+        """Structural preconditions for the layered step, checked once the
+        params are materialized: a model that opted in, a stacked scan
+        blocks subtree, and no block leaf sharded on the layer dim."""
+        from deepspeed_tpu.runtime.zero.partition_parameters import zero_gather_dim
+        if not getattr(self.module, "supports_layered_zero3", False):
+            reason = "model does not declare supports_layered_zero3"
+        else:
+            params = self.state.params
+            blocks = params.get("blocks") if isinstance(params, dict) else None
+            stacked = (isinstance(blocks, dict) and blocks
+                       and not any(len(k) > 1 and k[0] == "h" and k[1:].isdigit()
+                                   for k in blocks))
+            if not stacked:
+                reason = "params['blocks'] is not a stacked scan layout"
+            else:
+                dims = [zero_gather_dim(s.spec, self._cc["axes"])
+                        for s in jax.tree.leaves(self.param_shardings["blocks"])]
+                leads = {int(x.shape[0]) for x in jax.tree.leaves(blocks)}
+                if any(d == 0 for d in dims):
+                    reason = "a stacked block leaf is sharded on the layer dim"
+                elif len(leads) != 1:
+                    reason = "stacked block leaves disagree on the layer count"
+                else:
+                    self._cc["n_layer"] = leads.pop()
+                    log_dist("layered ZeRO-3 active: per-block gather/"
+                             "reduce-scatter inside the scan, prefetch_depth="
+                             f"{self._cc['prefetch_depth']}", ranks=[0])
+                    return True
+        log_dist(f"layered ZeRO-3 requested but unavailable ({reason}) — "
+                 "keeping the bulk stage-3 program", ranks=[0])
+        return False
+
+    def _layered_active(self) -> bool:
+        """Whether this forward should take the layered step.  Re-checked
+        per call: compression/MoQ becoming schedule-active falls back to the
+        bulk step (whose loss path applies those transforms)."""
+        cc = getattr(self, "_cc", None)
+        if cc is None or not cc.get("overlap"):
+            return False
+        if (self._compression_spec is not None
+                and any(self._compression_enabled.values())):
+            return False
+        if self.quantizer is not None:
+            return False
+        if cc.get("layered") is None:
+            cc["layered"] = self._layered_capable()
+        return bool(cc["layered"])
+
+    def _cc_active(self) -> bool:
+        """cc dispatch gate: when cc was activated purely for overlap
+        (no quantization knobs) and the layered step turns out unavailable,
+        fall back to the standard XLA-scheduled program, not the bulk
+        explicit-collective one."""
+        cc = getattr(self, "_cc", None)
+        if cc is None:
+            return False
+        if cc.get("exact_only") and not self._layered_active():
+            return False
+        return True
+
+    def _layered_trees(self):
+        """(rest treedef, rest plan, blocks treedef, stacked blocks plan) —
+        the per-leaf gather dims split at the blocks subtree."""
+        from deepspeed_tpu.runtime.zero.partition_parameters import zero_gather_dim
+        axes = self._cc["axes"]
+        ps = self.param_shardings
+        rest_sh = {k: v for k, v in ps.items() if k != "blocks"}
+        rest_plan = [zero_gather_dim(s.spec, axes)
+                     for s in jax.tree.leaves(rest_sh)]
+        blocks_plan = [zero_gather_dim(s.spec, axes)
+                       for s in jax.tree.leaves(ps["blocks"])]
+        return (jax.tree.structure(rest_sh), rest_plan,
+                jax.tree.structure(ps["blocks"]), blocks_plan)
+
+    def _build_layered_secondary(self):
+        """hpZ refresh for the layered step: one standalone program running
+        only the slow-axis hop, producing the (stacked) secondary tree the
+        in-scan per-block fast regathers then feed from.  Bitwise the same
+        secondary the bulk ``hierarchical_gather`` builds — the slow hop
+        treats the layer dim as batch."""
+        from deepspeed_tpu.comm.compression import hpz as hpz_mod
+        cc = self._cc
+        axes = cc["axes"]
+        plan = self._cc_plan()
+        treedef = jax.tree.structure(self.state.params)
+        pspecs = jax.tree.map(lambda s: s.spec, self.param_shardings)
+
+        def sec_spec(spec, d):
+            if d is None:
+                return PartitionSpec()
+            entries = list(spec) + [None] * (d + 1 - len(spec))
+            entries[d] = axes[-1]
+            return PartitionSpec(*entries)
+
+        sec_specs = jax.tree.unflatten(treedef, [
+            sec_spec(s, d) for s, d in zip(jax.tree.leaves(pspecs), plan)])
+
+        def body(params):
+            outs = []
+            for x, d in zip(jax.tree.leaves(params), plan):
+                if d is None:
+                    outs.append(x.astype(jnp.bfloat16))
+                else:
+                    outs.append(hpz_mod.slow_gather_secondary(
+                        x, d, axes, quantize_bits=cc["qw_bits"],
+                        block_size=cc["block"]))
+            return jax.tree.unflatten(treedef, outs)
+
+        fn = mesh_lib.shard_map(body, mesh=self.mesh, in_specs=(pspecs,),
+                                out_specs=sec_specs, check_vma=False)
+        return jax.jit(fn)
+
+    def _build_layered_step(self, batch, reuse: bool = False):
+        """The layered stage-3 train step.  Differences from
+        ``_build_cc_step``: the stacked ``params["blocks"]`` enter the loss
+        function STILL SHARDED — the model's scan gathers one block slice
+        per iteration through ``compression/layered.py``'s custom-vjp rules
+        (prefetch ring issued ``prefetch_depth`` blocks ahead), and the
+        scan transpose reduce-scatters each block's grads the moment its
+        backward slice completes.  Non-block leaves keep the bulk per-leaf
+        treatment.  Loss and grads are parity-identical to the bulk step.
+        """
+        from deepspeed_tpu.comm.compression import layered as layered_mod
+        from deepspeed_tpu.comm.compression import qgz
+        cc = self._cc
+        axes, sizes = cc["axes"], cc["sizes"]
+        group = axes if len(axes) > 1 else axes[0]
+        hpz = cc["hpz"]
+        rest_def, rest_plan, blocks_def, blocks_plan = self._layered_trees()
+        slice_plan = jax.tree.unflatten(
+            blocks_def, [None if d is None else d - 1 for d in blocks_plan])
+        pf = layered_mod.LayeredPrefetch(
+            slice_plan, cc, self.compute_dtype, hpz=hpz, reuse=reuse,
+            depth=cc["prefetch_depth"])
+
+        baxes = mesh_lib.BATCH_AXES
+        bspec = jax.tree.map(
+            lambda x: PartitionSpec(baxes) if getattr(x, "ndim", 0) >= 1
+            else PartitionSpec(), batch)
+        pspecs = jax.tree.map(lambda s: s.spec, self.param_shardings)
+        gspecs = jax.tree.map(lambda s: s.spec, self.grad_shardings)
+
+        def reduce_rest(g, d):
+            if d is None:
+                return jax.lax.pmean(g, group)
+            return qgz.hierarchical_reduce_scatter(
+                g, d, axes, bits=cc["qg_bits"], block_size=cc["block"],
+                mean=True)
+
+        def run(params, secs, batch, rng, scale):
+            batch = self._cast_batch(batch)
+            rest = {k: v for k, v in params.items() if k != "blocks"}
+            if hpz:
+                blocks_in = {"p": params["blocks"], "s": secs["blocks"]}
+                rest_pairs = zip(
+                    jax.tree.leaves(rest),
+                    jax.tree.leaves({k: v for k, v in secs.items()
+                                     if k != "blocks"}))
+            else:
+                blocks_in = params["blocks"]
+                rest_pairs = zip(jax.tree.leaves(rest),
+                                 [None] * len(rest_plan))
+            rest_full = jax.tree.unflatten(rest_def, [
+                _layered_rest_gather(x, s, d, cc, reuse)
+                for (x, s), d in zip(rest_pairs, rest_plan)])
+
+            def scaled_loss(rest_full, blocks_in):
+                p = dict(jax.tree.map(
+                    lambda a: a.astype(self.compute_dtype), rest_full))
+                p["blocks"] = blocks_in
+                with layered_mod.block_prefetch_scope(pf):
+                    out = self._loss_fn(p, batch, rng, True)
+                loss, aux = (out if isinstance(out, tuple) else (out, None))
+                return loss.astype(jnp.float32) * scale, (loss, aux)
+
+            with mesh_lib.manual_sharding():
+                (rest_g, blocks_g), (loss, _aux) = jax.grad(
+                    scaled_loss, argnums=(0, 1), has_aux=True)(
+                        rest_full, blocks_in)
+            if hpz:
+                blocks_g = blocks_g["p"]
+            grads = dict(jax.tree.unflatten(rest_def, [
+                reduce_rest(g, d)
+                for g, d in zip(jax.tree.leaves(rest_g), rest_plan)]))
+            grads["blocks"] = blocks_g
+            return jax.lax.pmean(loss, group), grads
+
+        if hpz:
+            plan = self._cc_plan()
+
+            def sec_spec(spec, d):
+                if d is None:
+                    return PartitionSpec()
+                entries = list(spec) + [None] * (d + 1 - len(spec))
+                entries[d] = axes[-1]
+                return PartitionSpec(*entries)
+
+            sec_specs = jax.tree.unflatten(
+                jax.tree.structure(self.state.params),
+                [sec_spec(s, d)
+                 for s, d in zip(jax.tree.leaves(pspecs), plan)])
+            fn = mesh_lib.shard_map(
+                run, mesh=self.mesh,
+                in_specs=(pspecs, sec_specs, bspec, PartitionSpec(),
+                          PartitionSpec()),
+                out_specs=(PartitionSpec(), gspecs), check_vma=False)
+            return jax.jit(fn)
+
+        def body(params, batch, rng, scale):
+            return run(params, None, batch, rng, scale)
+
+        fn = mesh_lib.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(pspecs, bspec, PartitionSpec(), PartitionSpec()),
+            out_specs=(PartitionSpec(), gspecs), check_vma=False)
+        return jax.jit(fn)
+
     def _maybe_offload(self, shardings, opt_shapes):
         """ZeRO-Offload: place optimizer state in host memory
         (reference ``offload_optimizer.device=cpu`` → CPUAdam path,
@@ -1052,6 +1338,10 @@ class DeepSpeedEngine:
             self._cc_step = None
             self._cc_step_reuse = None
             self._hpz_secondary = None
+            self._layered_step = None
+            self._layered_step_reuse = None
+            self._layered_secondary_prog = None
+            self._cc_bytes_tables = {}
 
     def _eigenvalue_factor(self) -> float:
         """MoQ curvature factor (reference engine.py:2013-2017): every
@@ -1412,10 +1702,47 @@ class DeepSpeedEngine:
         if self.watchdog is not None:
             self.watchdog.arm(f"fwd step={self.global_steps}")
 
+        fwd_mode = None
         with self._span("fwd", step=self.global_steps,
-                        micro_step=self.micro_steps):
+                        micro_step=self.micro_steps) as fwd_rec:
             if self._in_training_mode:
-                if getattr(self, "_cc", None) is not None:
+                if self._cc_active() and self._layered_active():
+                    # Layered ZeRO-3: blocks stay sharded through the scan;
+                    # per-block gathers prefetch ahead of use and per-block
+                    # reduce-scatters fire inside the scan transpose, so
+                    # the collectives hide under block compute.
+                    use_reuse = (self._cc["hpz"]
+                                 and self._hpz_secondary is not None)
+                    if self._cc["hpz"]:
+                        if not use_reuse:
+                            if self._layered_secondary_prog is None:
+                                self._layered_secondary_prog = (
+                                    self._build_layered_secondary())
+                            self._hpz_secondary = (
+                                self._layered_secondary_prog(
+                                    self.state.params))
+                        attr = ("_layered_step_reuse" if use_reuse
+                                else "_layered_step")
+                        step = getattr(self, attr)
+                        if step is None:
+                            step = self._build_layered_step(
+                                batch, reuse=use_reuse)
+                            setattr(self, attr, step)
+                        loss, grads = step(self.state.params,
+                                           self._hpz_secondary, batch,
+                                           self._next_rng(),
+                                           self.state.scaler.scale)
+                    else:
+                        if self._layered_step is None:
+                            self._layered_step = self._build_layered_step(
+                                batch)
+                        loss, grads = self._layered_step(
+                            self.state.params, batch, self._next_rng(),
+                            self.state.scaler.scale)
+                    self._grads_are_local = False
+                    self._append_cc_bytes(reuse=use_reuse, layered=True)
+                    fwd_mode = "layered"
+                elif self._cc_active():
                     # ZeRO++ path: explicit (compressed) gather + hierarchical
                     # reduce-scatter programs instead of XLA-inserted exact
                     # collectives.  hpZ reuses the persisted secondary shard
@@ -1441,6 +1768,7 @@ class DeepSpeedEngine:
                             loss, grads = out
                     self._grads_are_local = False
                     self._append_cc_bytes(reuse=use_reuse)
+                    fwd_mode = "bulk"
                 elif self._onebit_active():
                     # post-freeze 1-bit path: gradients stay per-device here
                     # and travel compressed at the gas boundary (step())
@@ -1465,6 +1793,19 @@ class DeepSpeedEngine:
                 loss = self._eval_step(self.state.params, batch, self._next_rng())
                 self._cached_loss = loss
 
+        if (fwd_mode is not None and self.tracer is not None
+                and fwd_rec is not None and fwd_rec.get("t1") is not None):
+            # analytic zero3.comm / zero3.compute lanes inside the measured
+            # step window — host spans fire at trace time and cannot see
+            # device concurrency, so the schedule the program structure
+            # admits is emitted explicitly (trace_merge computes the
+            # overlap fraction from these lanes)
+            from deepspeed_tpu.telemetry.tracing import emit_zero3_schedule
+            n = self._cc.get("n_layer") or getattr(
+                getattr(self.module, "cfg", None), "n_layer", None) or 1
+            emit_zero3_schedule(self.tracer, fwd_rec["t0"], fwd_rec["t1"],
+                                n_blocks=n, layered=(fwd_mode == "layered"),
+                                depth=self._cc.get("prefetch_depth", 1))
         self.timers(FORWARD_MICRO_TIMER).stop(sync=False)
         return loss
 
@@ -1713,6 +2054,10 @@ class DeepSpeedEngine:
         self._fused_step = None
         if getattr(self, "_apply_step_ob", None) is not None:
             self._apply_step_ob = None
+        # per-step comm byte tables are config-derived; any event that
+        # invalidates programs may also have changed what a step moves
+        if getattr(self, "_cc_bytes_tables", None):
+            self._cc_bytes_tables = {}
 
     def _stability_boundary(self, stats):
         """Boundary half of the sentinel: buffer this step's stats, judge

@@ -114,6 +114,11 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     zero_quantization_block_size: int = Field(256, ge=2)
     """Elements per quantization block (one fp32 scale + zero-point each)."""
 
+    prefetch_depth: int = Field(1, ge=1)
+    """Layered stage-3 only: how many block-parameter slices the scan keeps
+    in flight ahead of the block currently computing.  1 = classic double
+    buffering (gather block ``i+1`` while block ``i`` computes)."""
+
     @model_validator(mode="after")
     def quantization_valid(self):
         for name in ("zero_quantized_weights_bits", "zero_quantized_gradients_bits"):
@@ -127,9 +132,18 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
 
     @model_validator(mode="after")
     def overlap_comm_valid(self):
+        # Remember whether the user *asked* for overlap before we default it:
+        # the layered stage-3 step is opt-in, so an explicit ``true`` means
+        # "restructure the program", while the reference-compatible implicit
+        # default below only records intent.  Stored via ``__dict__`` so the
+        # pydantic field set is untouched (assignment below would pollute it).
+        self.__dict__["overlap_comm_explicit"] = self.overlap_comm is not None
         if self.overlap_comm is None:
             # Reference defaults overlap_comm True for stage 3, False otherwise.
-            self.overlap_comm = self.stage == 3
+            # Written through __dict__: plain assignment would trigger
+            # validate_assignment's re-validation pass, which rebuilds
+            # __dict__ and wipes the stash above.
+            self.__dict__["overlap_comm"] = self.stage == 3
         return self
 
     @model_validator(mode="after")

@@ -33,16 +33,23 @@ Pytree = Any
 
 def zero_partition_spec(shape, fsdp_size: int, min_size: int = 2**12,
                         existing: Optional[PartitionSpec] = None,
-                        axes=("fsdp",)) -> PartitionSpec:
+                        axes=("fsdp",), reserve_leading: bool = False) -> PartitionSpec:
     """PartitionSpec sharding one dim over the ZeRO axes (default 'fsdp'),
-    composed with an existing (e.g. tensor-parallel) spec."""
+    composed with an existing (e.g. tensor-parallel) spec.
+
+    ``reserve_leading`` excludes dim 0 from the candidates — used for
+    scan-stacked per-block leaves, whose leading dim is the *layer* index:
+    the layered stage-3 step slices it one block at a time inside the scan
+    (``comm/compression/layered.py``), which is only expressible when every
+    device holds all L slices of its shard."""
     existing = existing or PartitionSpec()
     n = int(np.prod(shape)) if shape else 1
     if fsdp_size <= 1 or n < max(min_size, fsdp_size):
         return existing
     spec = list(existing) + [None] * (len(shape) - len(existing))
     # fsdp goes on the largest still-unsharded divisible dim
-    free = [d for d in range(len(shape)) if spec[d] is None]
+    free = [d for d in range(len(shape)) if spec[d] is None
+            and not (reserve_leading and d == 0)]
     best, best_size = None, 0
     for d in free:
         if shape[d] % fsdp_size == 0 and shape[d] > best_size:
@@ -55,10 +62,31 @@ def zero_partition_spec(shape, fsdp_size: int, min_size: int = 2**12,
     return PartitionSpec(*spec)
 
 
-def _leaf_spec(leaf, fsdp_size, min_size, logical_spec=None, axes=("fsdp",)):
+def _leaf_spec(leaf, fsdp_size, min_size, logical_spec=None, axes=("fsdp",),
+               reserve_leading=False):
     shape = np.shape(leaf) if not hasattr(leaf, "shape") else leaf.shape
     return zero_partition_spec(shape, fsdp_size, min_size, existing=logical_spec,
-                               axes=axes)
+                               axes=axes, reserve_leading=reserve_leading)
+
+
+def _path_keys(path):
+    out = []
+    for p in path:
+        k = getattr(p, "key", getattr(p, "name", getattr(p, "idx", None)))
+        out.append(str(k))
+    return tuple(out)
+
+
+def is_stacked_block_path(keys) -> bool:
+    """True when a tree path addresses a scan-stacked per-block leaf:
+    somewhere under a ``blocks`` subtree that is NOT the per-layer dict
+    layout (``blocks/h0/...``).  Such leaves carry the layer index as dim 0
+    and must keep it unsharded (see ``zero_partition_spec``)."""
+    keys = tuple(str(k) for k in keys)
+    if "blocks" not in keys:
+        return False
+    after = keys[keys.index("blocks") + 1:]
+    return not any(len(k) > 1 and k[0] == "h" and k[1:].isdigit() for k in after)
 
 
 class ZeroShardingPolicy:
@@ -77,12 +105,16 @@ class ZeroShardingPolicy:
         self.fsdp_size = int(np.prod([mesh.shape[a] for a in self.axes]))
 
     def _sharded(self, tree: Pytree, logical_specs: Optional[Pytree] = None) -> Pytree:
-        def make(leaf, lspec=None):
-            spec = _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec, self.axes)
-            return NamedSharding(self.mesh, spec)
-        if logical_specs is None:
-            return jax.tree.map(make, tree)
-        return jax.tree.map(make, tree, logical_specs)
+        is_spec_leaf = lambda x: x is None or isinstance(x, PartitionSpec)
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        lspecs = (jax.tree.leaves(logical_specs, is_leaf=is_spec_leaf)
+                  if logical_specs is not None else [None] * len(flat))
+        shardings = [
+            NamedSharding(self.mesh, _leaf_spec(
+                leaf, self.fsdp_size, self.min_size, lspec, self.axes,
+                reserve_leading=is_stacked_block_path(_path_keys(path))))
+            for (path, leaf), lspec in zip(flat, lspecs)]
+        return jax.tree_util.tree_unflatten(jax.tree.structure(tree), shardings)
 
     def _replicated(self, tree: Pytree, logical_specs: Optional[Pytree] = None) -> Pytree:
         def make(leaf, lspec=None):
@@ -127,22 +159,17 @@ class ZeroShardingPolicy:
         lspecs = logical_specs if logical_specs is not None else jax.tree.map(lambda _: None, params)
         is_spec_leaf = lambda x: x is None or isinstance(x, PartitionSpec)
 
-        def path_keys(path):
-            out = []
-            for p in path:
-                k = getattr(p, "key", getattr(p, "name", getattr(p, "idx", None)))
-                out.append(str(k))
-            return tuple(out)
-
-        param_paths = [(path_keys(path), tuple(leaf.shape),
+        param_paths = [(_path_keys(path), tuple(leaf.shape),
                         _leaf_spec(leaf, self.fsdp_size, self.min_size, lspec,
-                                   self.axes))
+                                   self.axes,
+                                   reserve_leading=is_stacked_block_path(
+                                       _path_keys(path))))
                        for (path, leaf), lspec in zip(
                            jax.tree_util.tree_flatten_with_path(params)[0],
                            jax.tree.leaves(lspecs, is_leaf=is_spec_leaf))]
 
         def lookup(path, shape):
-            keys = path_keys(path)
+            keys = _path_keys(path)
             best = None
             for pkeys, pshape, spec in param_paths:
                 if pshape != shape:

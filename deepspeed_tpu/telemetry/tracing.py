@@ -283,3 +283,51 @@ def maybe_span(name: str, **args):
     off — the one-liner instrumentation points use."""
     t = _GLOBAL_TRACER
     return t.span(name, **args) if t is not None else nullcontext()
+
+
+# --------------------------------------------------------------------------- #
+# ZeRO-3 schedule lanes — the compute/communication overlap record
+# --------------------------------------------------------------------------- #
+def emit_zero3_schedule(tracer: Tracer, t0_ns: int, t1_ns: int,
+                        n_blocks: int, layered: bool, depth: int = 1):
+    """Emit synthetic ``zero3.comm`` / ``zero3.compute`` lanes describing
+    the stage-3 step's dependence structure inside the measured fwd window.
+
+    Host-side spans fire at TRACE time (they nest inside the fwd span and
+    observe no device concurrency), so real gather/compute simultaneity is
+    invisible to the tracer.  What IS knowable host-side is the schedule
+    the program structure admits — the same convention the pipeline
+    schedule-slot lanes use.  The bulk step's all-gather strictly precedes
+    the first block and its reduce-scatter strictly follows the last
+    (overlap fraction ~0); the layered step issues block *i+depth*'s
+    gather alongside block *i*'s compute and block *i*'s reduce-scatter
+    alongside the backward of block *i+1* (overlap fraction L/(L+2)).
+
+    ``tools/trace_merge.py`` computes the overlap fraction from these
+    lanes via interval intersection on ``args.kind``.
+    """
+    L = max(1, int(n_blocks))
+    span = max(1, int(t1_ns) - int(t0_ns))
+    slots = L + 2
+    dt = span / slots
+
+    def at(i):
+        return int(t0_ns + i * dt)
+
+    if layered:
+        for i in range(L):
+            tracer.add_span("zero3.gather", at(i), at(i + 1),
+                            track="zero3.comm", kind="comm", block=i,
+                            depth=depth)
+            tracer.add_span("zero3.block", at(i + 1), at(i + 2),
+                            track="zero3.compute", kind="compute", block=i)
+            tracer.add_span("zero3.reduce_scatter", at(i + 2), at(i + 3),
+                            track="zero3.comm", kind="comm", block=i)
+    else:
+        tracer.add_span("zero3.all_gather", at(0), at(1),
+                        track="zero3.comm", kind="comm")
+        for i in range(L):
+            tracer.add_span("zero3.block", at(i + 1), at(i + 2),
+                            track="zero3.compute", kind="compute", block=i)
+        tracer.add_span("zero3.reduce_scatter", at(L + 1), at(L + 2),
+                        track="zero3.comm", kind="comm")
