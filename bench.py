@@ -34,6 +34,14 @@ parses the final line — and every record persisted to
                 says whether p99 TTFT stayed under it.
   vs_baseline = p99 TTFT bound / measured p99 TTFT (>= 1 means the SLO
                 held with margin).
+* ``offload``: beyond-HBM tiered offload (``runtime/offload``) — the same
+  layered stage-3 step with the parameter+optimizer state on the NVMe
+  tier vs fully in HBM, plus the ZeRO-Infinity refused-without /
+  trains-with HBM-budget proof and the staging audit fold.
+  value = vs_baseline = offloaded / in-HBM throughput fraction.
+* ``multichip``: the offloaded layered step on an 8-device mesh (re-execs
+  onto 8 virtual host devices when fewer are attached).
+  value = samples/sec; vs_baseline = offloaded / in-HBM on the same mesh.
 
 Timing methodology: the driver may run this through a remote-tunneled TPU
 runtime where ``jax.block_until_ready`` returns before device execution
@@ -42,7 +50,8 @@ dispatch chains of different lengths, each ended by a single scalar fetch
 (the only true sync point), and the per-step cost is the difference — the
 fixed round-trip and dispatch overheads cancel.
 
-Env knobs: BENCH_MODE (all|train|bert|decode|comm|serve), BENCH_MODEL (gpt2|gpt2-medium|
+Env knobs: BENCH_MODE (all|train|bert|decode|comm|serve|offload|multichip),
+BENCH_MODEL (gpt2|gpt2-medium|
 gpt2-large|gpt2-xl | bert-base|bert-large), BENCH_SEQ (default 512 train /
 128 bert), BENCH_MICRO (default 8 train / 32 bert), BENCH_STEPS (default
 16), BENCH_REMAT (1 = activation checkpointing, default 1 — remat with the
@@ -472,6 +481,250 @@ def bench_serve():
     return rec
 
 
+def _offload_train_config(micro, nvme_path=None, budget=0, telemetry_path=None):
+    """Engine config for the offload rungs: layered stage 3, with the
+    parameter+optimizer NVMe tiers when ``nvme_path`` is given."""
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3, "overlap_comm": True,
+                              "prefetch_depth": int(os.environ.get(
+                                  "BENCH_OFFLOAD_DEPTH", "2"))},
+        "bf16": {"enabled": os.environ.get("BENCH_DTYPE", "bfloat16")
+                 == "bfloat16"},
+        "steps_per_print": 10 ** 9,
+    }
+    if nvme_path:
+        config["zero_optimization"]["offload_param"] = {
+            "device": "nvme", "nvme_path": nvme_path}
+        config["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": nvme_path, "pipeline_write": True}
+    if budget:
+        config["zero_optimization"]["hbm_budget_bytes"] = int(budget)
+    if telemetry_path:
+        config["telemetry"] = {"enabled": True, "jsonl_path": telemetry_path}
+    return config
+
+
+def bench_offload():
+    """Beyond-HBM offload rung: the SAME layered stage-3 train step with
+    parameters+optimizer on the NVMe tier vs fully in HBM.
+
+    value       = sustained throughput fraction (offloaded / in-HBM) — how
+                  much of the in-memory speed the prefetch ring preserves
+                  while the model state lives beyond HBM.
+    vs_baseline = value / 1.0 (parity with the in-HBM step).
+
+    The record also carries the ZeRO-Infinity proof pair: a plain stage-3
+    engine REFUSES a budget sized between the offloaded window peak and
+    the plain gathered peak (``HBMBudgetError`` at init, not an OOM
+    mid-step), while the offload engine under the same budget trains —
+    plus the staging audit (``tools/offload_audit.py`` fold) whose stall
+    fraction gates the rung (BENCH_OFFLOAD_MAX_STALL, default 1.0)."""
+    import shutil
+    import tempfile
+
+    import importlib.util
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+    from deepspeed_tpu.runtime.offload import HBMBudgetError, plan_residency
+
+    n_dev = jax.device_count()
+    preset = os.environ.get("BENCH_MODEL", "gpt2")
+    seq = int(os.environ.get("BENCH_SEQ", "256"))
+    micro = int(os.environ.get("BENCH_MICRO", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "8"))
+    max_stall = float(os.environ.get("BENCH_OFFLOAD_MAX_STALL", "1.0"))
+
+    cfg = gpt_config(preset, n_positions=seq, scan_layers=True,
+                     attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, micro * n_dev, seq)),
+                      jnp.int32)
+    batch = (ids, ids)
+    tmp = tempfile.mkdtemp(prefix="bench_offload_")
+
+    def measure(nvme_path=None, telemetry_path=None):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg),
+            config=_offload_train_config(micro, nvme_path, 0, telemetry_path),
+            seed=7)
+        engine.tput_timer.start_step = 10 ** 12
+        for _ in range(2):
+            loss = engine.train_batch(batch=batch)
+        float(loss)
+        per_step, loss_val = _chain_timer(
+            lambda: engine.train_batch(batch=batch), lambda l: float(l),
+            steps=steps, trials=2)
+        return engine, per_step, loss_val
+
+    try:
+        tele_path = os.path.join(tmp, "telemetry.jsonl")
+        e_hbm, t_hbm, loss_hbm = measure()
+        e_off, t_off, loss_off = measure(os.path.join(tmp, "nvme"), tele_path)
+        fraction = t_hbm / t_off if t_off > 0 else 0.0
+
+        # the ZeRO-Infinity proof: a budget the gathered plain step cannot
+        # fit but the offloaded layer window can
+        plan = plan_residency(
+            e_off.state.params, None, budget_bytes=1, world=n_dev,
+            compute_itemsize=jnp.dtype(e_off.compute_dtype).itemsize,
+            prefetch_depth=int(os.environ.get("BENCH_OFFLOAD_DEPTH", "2")),
+            params_tier="nvme", optimizer_tier="nvme")
+        budget = max(int(plan.window_peak_bytes * 1.25),
+                     (plan.window_peak_bytes + plan.plain_peak_bytes) // 2)
+        refused = False
+        try:
+            deepspeed_tpu.initialize(
+                model=GPT(cfg), config=_offload_train_config(micro, None, budget),
+                seed=7)
+        except HBMBudgetError:
+            refused = True
+        e_b, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg),
+            config=_offload_train_config(micro, os.path.join(tmp, "nvme_b"),
+                                         budget),
+            seed=7)
+        e_b.tput_timer.start_step = 10 ** 12
+        float(e_b.train_batch(batch=batch))
+
+        if e_off.telemetry is not None:
+            e_off.telemetry.close()
+        spec = importlib.util.spec_from_file_location(
+            "offload_audit", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "offload_audit.py"))
+        audit_mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(audit_mod)
+        staged, step_ms, audit_err = audit_mod.load_records(tele_path)
+        audit = (audit_mod.audit(staged, step_ms) if audit_err is None
+                 else {"error": audit_err})
+
+        rec = {
+            "metric": f"beyond-HBM offload throughput fraction ({preset}, "
+                      f"seq={seq}, micro={micro}, NVMe param+opt tiers, "
+                      f"{n_dev}x{jax.devices()[0].platform})",
+            "value": round(fraction, 4),
+            "unit": "x of in-HBM throughput",
+            "vs_baseline": round(fraction, 4),
+            "in_hbm_step_ms": round(t_hbm * 1e3, 2),
+            "offload_step_ms": round(t_off * 1e3, 2),
+            "loss_delta": round(abs(loss_off - loss_hbm), 6),
+            "hbm_budget_bytes": budget,
+            "plain_peak_bytes": plan.plain_peak_bytes,
+            "window_peak_bytes": plan.window_peak_bytes,
+            "refused_without_offload": refused,
+            "trains_with_offload_under_budget": True,
+            "stall_frac": audit.get("stall_frac"),
+            "ring_hit_rate": audit.get("hit_rate"),
+            "bytes_staged_out": audit.get("bytes_written"),
+            "bytes_staged_in": audit.get("bytes_read"),
+            "audit_ok": (audit.get("stall_frac") is not None
+                         and audit["stall_frac"] <= max_stall),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(rec))
+    return rec
+
+
+def bench_multichip():
+    """Dedicated multichip rung: the offloaded layered step on an 8-device
+    mesh (the smallest topology where the fsdp collectives, the prefetch
+    ring, and the per-block writeback all cross device boundaries).
+
+    value       = offloaded training samples/sec on the 8-device mesh.
+    vs_baseline = offloaded / in-HBM throughput on the SAME mesh (the
+                  multichip analogue of the ``offload`` rung headline).
+
+    When fewer than 8 devices are attached the rung re-execs itself in a
+    child process on 8 virtual host devices (XLA_FLAGS
+    ``--xla_force_host_platform_device_count=8`` — same mechanism the test
+    suite uses) so the schedule is still exercised on every commit."""
+    import subprocess
+
+    import jax
+
+    if jax.device_count() < 8 and not os.environ.get("BENCH_MULTICHIP_CHILD"):
+        env = dict(os.environ,
+                   BENCH_MULTICHIP_CHILD="1", BENCH_MODE="multichip",
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_count=8"))
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=float(os.environ.get(
+                               "BENCH_RUNG_TIMEOUT_S", "600")))
+        for line in reversed((p.stdout or "").strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                if isinstance(rec, dict) and "value" in rec:
+                    rec["virtual_devices"] = True
+                    print(json.dumps(rec))
+                    return rec
+            except ValueError:
+                continue
+        raise RuntimeError(
+            f"multichip child produced no record (rc={p.returncode}): "
+            + (p.stderr or "").strip()[-300:])
+
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT, gpt_config
+
+    n_dev = jax.device_count()
+    micro = int(os.environ.get("BENCH_MC_MICRO", "2"))
+    seq = int(os.environ.get("BENCH_MC_SEQ", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "8"))
+    cfg = gpt_config("tiny", n_positions=seq, scan_layers=True,
+                     attn_impl="reference")
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, micro * n_dev, seq)),
+                      jnp.int32)
+    batch = (ids, ids)
+    tmp = tempfile.mkdtemp(prefix="bench_mc_")
+
+    def measure(nvme_path=None):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config=_offload_train_config(micro, nvme_path),
+            seed=7)
+        engine.tput_timer.start_step = 10 ** 12
+        for _ in range(2):
+            loss = engine.train_batch(batch=batch)
+        float(loss)
+        per_step, _ = _chain_timer(
+            lambda: engine.train_batch(batch=batch), lambda l: float(l),
+            steps=steps, trials=2)
+        return engine, per_step
+
+    try:
+        _, t_hbm = measure()
+        e_off, t_off = measure(os.path.join(tmp, "nvme"))
+        sps = micro * n_dev / t_off
+        stats = e_off.param_swapper.stats() if e_off.param_swapper else {}
+        rec = {
+            "metric": f"multichip offloaded train samples/sec (tiny GPT, "
+                      f"seq={seq}, micro={micro}, "
+                      f"{n_dev}x{jax.devices()[0].platform})",
+            "value": round(sps, 2),
+            "unit": "samples/sec",
+            "vs_baseline": round(t_hbm / t_off, 4) if t_off > 0 else 0.0,
+            "n_devices": n_dev,
+            "in_hbm_step_ms": round(t_hbm * 1e3, 2),
+            "offload_step_ms": round(t_off * 1e3, 2),
+            "bytes_staged_out": int(stats.get("bytes_written", 0)),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(rec))
+    return rec
+
+
 def _detail_path():
     """BENCH_DETAIL_r{N}.json, N = the round the driver will record next
     (one past the newest BENCH_r{N}.json in the repo)."""
@@ -617,7 +870,8 @@ def main():
         # unknown modes raise (a typo must not silently run the full suite)
         run_rung(mode, {"train": bench_train, "bert": bench_bert,
                         "decode": bench_decode, "comm": bench_comm,
-                        "serve": bench_serve}[mode])
+                        "serve": bench_serve, "offload": bench_offload,
+                        "multichip": bench_multichip}[mode])
         watchdog.stop()
         return
     # default: the full rung set — decode (bf16 + int8 weight-only), BERT
@@ -629,6 +883,8 @@ def main():
                      ("bert", bench_bert),
                      ("comm", bench_comm),
                      ("serve", bench_serve),
+                     ("offload", bench_offload),
+                     ("multichip", bench_multichip),
                      ("train", bench_train)):
         try:
             detail[name] = run_rung(name, fn)

@@ -43,7 +43,34 @@ from jax import lax
 from deepspeed_tpu.comm.compression import hpz as hpz_mod
 from deepspeed_tpu.comm.compression import qgz, qwz
 
+try:  # jax >= 0.4.x keeps this private; absence just disables staging
+    from jax._src.sharding_impls import TransferToMemoryKind as _Transfer
+except ImportError:  # pragma: no cover - older/newer jax layouts
+    _Transfer = None
+
 _scope = threading.local()
+
+
+def _stage_to_device(x):
+    """Per-slice host→HBM stage for offloaded (``pinned_host``) block
+    leaves — the device half of the offload prefetch ring.
+
+    Issued inside the slice-gather ``custom_vjp`` *impl*, so it rides the
+    same double-buffered ring as the collective: the transfer for block
+    ``i + depth`` is in flight while block ``i`` computes, and the
+    backward rule is untouched (cotangents stay in device memory with the
+    gradient accumulator).  Whole-tree host→device transfers inside the
+    scan body are exactly what ``tools/check_overlap_structure.py`` lints
+    against; this per-slice form is the sanctioned site.  On backends
+    without memory-kind support (CPU tests) the transfer is an identity,
+    keeping layered-vs-bulk parity bitwise.
+    """
+    if _Transfer is None:
+        return x
+    try:
+        return jax.device_put(x, _Transfer("device"))
+    except Exception:
+        return x
 
 
 @contextlib.contextmanager
@@ -82,15 +109,18 @@ def _reduce_slice(ct, d, axes, qg_bits, block):
                                            block_size=block, mean=True)
 
 
-def _replicated_gather(group):
+def _replicated_gather(group, stage=False):
     """Replicated leaf (below the shard threshold): identity forward,
     gradient-mean backward — the bulk path's ``pmean`` per leaf."""
+    def impl(x):
+        return _stage_to_device(x) if stage else x
+
     @jax.custom_vjp
     def gather(x):
-        return x
+        return impl(x)
 
     def fwd(x):
-        return x, None
+        return impl(x), None
 
     def bwd(_, ct):
         return (lax.pmean(ct, group),)
@@ -99,15 +129,21 @@ def _replicated_gather(group):
     return gather
 
 
-def _sharded_gather(d, axes, group, qw_bits, qg_bits, block):
+def _sharded_gather(d, axes, group, qw_bits, qg_bits, block, stage=False):
     """Sharded leaf, primary-shard gather: exact tiled all-gather, or the
-    qwZ blockwise-quantized wire format when ``qw_bits`` is set."""
+    qwZ blockwise-quantized wire format when ``qw_bits`` is set.  With
+    ``stage`` the host-resident shard slice is moved into device memory
+    first, so the wire carries device-side bytes."""
     if qw_bits is not None:
         def impl(x):
+            if stage:
+                x = _stage_to_device(x)
             return qwz.quantized_all_gather(x, axes, dim=d, bits=qw_bits,
                                             block_size=block)
     else:
         def impl(x):
+            if stage:
+                x = _stage_to_device(x)
             return lax.all_gather(x, group, axis=d, tiled=True)
 
     @jax.custom_vjp
@@ -124,7 +160,7 @@ def _sharded_gather(d, axes, group, qw_bits, qg_bits, block):
     return gather
 
 
-def _hpz_gather(d, axes, sizes, group, qg_bits, block, reuse):
+def _hpz_gather(d, axes, sizes, group, qg_bits, block, reuse, stage=False):
     """hpZ leaf: forward regathers the persisted secondary shard over the
     fast axis only (both refresh and reuse — the refresh-path full tensor
     *is* the fast regather of the just-built secondary, see
@@ -137,12 +173,17 @@ def _hpz_gather(d, axes, sizes, group, qg_bits, block, reuse):
     """
     if d is None:
         def impl(p, s):
-            return s.astype(jnp.float32) if reuse else p
+            out = s.astype(jnp.float32) if reuse else p
+            return _stage_to_device(out) if stage else out
 
         def bwd(s, ct):
             return lax.pmean(ct, group), jnp.zeros_like(s)
     else:
         def impl(p, s):
+            # the hpZ secondary shard is the gathered-from copy: under
+            # offload it is the host-resident one, staged per slice
+            if stage:
+                s = _stage_to_device(s)
             return hpz_mod.fast_regather(s, d, axes[1], w_slow=sizes[0])
 
         def bwd(s, ct):
@@ -176,20 +217,23 @@ class LayeredPrefetch:
 
     def __init__(self, plan, cc: dict, compute_dtype,
                  hpz: bool = False, reuse: bool = False,
-                 depth: int = 1):
+                 depth: int = 1, offload: bool = False):
         axes, sizes = cc["axes"], cc["sizes"]
         group = axes if len(axes) > 1 else axes[0]
         qw, qg, block = cc["qw_bits"], cc["qg_bits"], cc["block"]
         self.hpz = hpz
         self.depth = max(1, int(depth))
         self.compute_dtype = compute_dtype
+        self.offload = bool(offload)
 
         def leaf_fn(d):
             if hpz:
-                return _hpz_gather(d, axes, sizes, group, qg, block, reuse)
+                return _hpz_gather(d, axes, sizes, group, qg, block, reuse,
+                                   stage=self.offload)
             if d is None:
-                return _replicated_gather(group)
-            return _sharded_gather(d, axes, group, qw, qg, block)
+                return _replicated_gather(group, stage=self.offload)
+            return _sharded_gather(d, axes, group, qw, qg, block,
+                                   stage=self.offload)
 
         # callables are pytree leaves: the fns tree mirrors one block slice
         self.fns = jax.tree.map(leaf_fn, plan,

@@ -67,11 +67,19 @@ def _layered_rest_gather(x, sec, d, cc, reuse):
     fast-axis regather of its (precomputed) secondary shard.  Kept outside
     ``_build_layered_step`` so the overlap-structure lint can assert the
     step body itself issues no whole-tree gathers (block leaves must only
-    be gathered slice-wise through ``compression/layered.py``)."""
+    be gathered slice-wise through ``compression/layered.py``).  Under
+    offload the host-resident shard stages to device memory first — the
+    rest leaves sit OUTSIDE the scan, so this per-leaf transfer happens
+    once per step ahead of block 0, not inside the ring."""
     from deepspeed_tpu.comm.compression import hpz as hpz_mod
+    from deepspeed_tpu.comm.compression import layered as layered_mod
     from deepspeed_tpu.comm.compression import qwz
     axes, sizes = cc["axes"], cc["sizes"]
     group = axes if len(axes) > 1 else axes[0]
+    if cc.get("offload"):
+        x = layered_mod._stage_to_device(x)
+        if sec is not None:
+            sec = layered_mod._stage_to_device(sec)
     if cc["hpz"]:
         if d is None:
             return sec.astype(jnp.float32) if reuse else x
@@ -193,6 +201,7 @@ class DeepSpeedEngine:
         self.optimizer_name_ = (self._config.optimizer_name if self.client_optimizer is None
                                 else "client")
         self._configure_optimizer()
+        self._configure_offload_engine()
 
         # ---- loss scaling --------------------------------------------- #
         if self.fp16_enabled:
@@ -675,8 +684,14 @@ class DeepSpeedEngine:
         folder = os.path.join(oc.nvme_path or "/tmp/dst_nvme", "optimizer")
         aio_cfg = get_aio_config(self._config._param_dict
                                  if hasattr(self._config, "_param_dict") else {})
-        self.optimizer_swapper = PartitionedOptimizerSwapper(folder, aio_cfg)
+        # max_in_cpu=0: the optimizer tier is the truly dematerialized one —
+        # host copies drop the moment the NVMe write is durable.  The engine
+        # opts into pipelined (async) writeback; swap_in joins any pending
+        # write for a key before reading it back.
+        self.optimizer_swapper = PartitionedOptimizerSwapper(
+            folder, aio_cfg, max_in_cpu=0, pipeline_write=True)
         self.optimizer_swapper.swap_out(self.state.opt_state)
+        self.optimizer_swapper.drain()
         self.state.opt_state = None      # device/host copies released
         log_dist(f"ZeRO-Infinity: optimizer state swapped to {folder} "
                  f"({self.optimizer_swapper.swapped_bytes() >> 20} MiB)",
@@ -687,6 +702,134 @@ class DeepSpeedEngine:
         if self.state.opt_state is None and self.optimizer_swapper is not None:
             self.state.opt_state = self.optimizer_swapper.swap_in(self.opt_shardings)
         return self.state.opt_state
+
+    def _offload_devices(self):
+        """(param_tier, optimizer_tier) as plain strings (none/cpu/nvme)."""
+        def dev(oc):
+            if oc is None:
+                return "none"
+            return str(getattr(oc, "device", "none")).split(".")[-1]
+        zc = self._config.zero_config
+        return dev(zc.offload_param), dev(zc.offload_optimizer)
+
+    def _configure_offload_engine(self):
+        """Tiered beyond-HBM offload (``runtime/offload/``): NVMe
+        write-through backing for offloaded parameters (per-block CRC'd
+        chunks, host LRU bounded by ``max_in_cpu``, rollback-coherent
+        invalidation) plus the init-time HBM-budget refusal.  The host
+        tier itself is the ``pinned_host`` shardings applied by
+        ``_init_parameters``/``_maybe_offload``; this adds the file tier
+        and the planner on top."""
+        self.param_swapper = None
+        self._offload_stats_prev = {}
+        self._residency_plan = None
+        zc = self._config.zero_config
+        p_dev, _ = self._offload_devices()
+        if p_dev == "nvme" and self.zero_policy.stage >= 3:
+            from deepspeed_tpu.runtime.swap_tensor import (
+                AsyncPartitionedParameterSwapper, get_aio_config)
+            oc = zc.offload_param
+            folder = os.path.join(oc.nvme_path or "/tmp/dst_nvme", "params")
+            aio_cfg = get_aio_config(self._config._param_dict
+                                     if hasattr(self._config, "_param_dict")
+                                     else {})
+            self.param_swapper = AsyncPartitionedParameterSwapper(
+                folder, aio_cfg, buffer_count=max(2, int(oc.buffer_count)),
+                max_in_cpu=int(oc.max_in_cpu),
+                chunk_paths=lambda key: "blocks" in key.split("__"))
+            # initial persist: the NVMe tier holds a durable copy from step
+            # 0 on; writes drain on the staging workers during warmup
+            self.param_swapper.swap_out_tree(self.state.params,
+                                             prefix="param", sync=False)
+            log_dist(f"ZeRO-Infinity: parameter chunks staging to {folder} "
+                     f"(max_in_cpu={int(oc.max_in_cpu) >> 20} MiB host LRU)",
+                     ranks=[0])
+        self._check_hbm_budget()
+
+    def _check_hbm_budget(self):
+        """Residency planner gate: when an HBM budget is configured
+        (``hbm_budget_bytes`` or the ``DST_HBM_BUDGET_BYTES`` env), size
+        the plain stage-3 peak and the offloaded layer window against it
+        and refuse (``HBMBudgetError``) instead of OOMing mid-step."""
+        from deepspeed_tpu.runtime import offload as offload_mod
+        zc = self._config.zero_config
+        budget = (int(os.environ.get("DST_HBM_BUDGET_BYTES", "0") or 0)
+                  or int(getattr(zc, "hbm_budget_bytes", 0) or 0))
+        if budget <= 0:
+            return
+        p_dev, o_dev = self._offload_devices()
+        cc = getattr(self, "_cc", None) or {}
+        sizes = cc.get("sizes") or (
+            int(np.prod(list(self.mesh.shape.values()))),)
+        depth = int(cc.get("prefetch_depth",
+                           getattr(zc, "prefetch_depth", 1)))
+        plan = offload_mod.plan_residency(
+            self.state.params, self.state.opt_state,
+            budget_bytes=budget, world=int(np.prod(sizes)),
+            compute_itemsize=int(np.dtype(self.compute_dtype).itemsize),
+            prefetch_depth=depth,
+            params_tier="hbm" if p_dev == "none" else p_dev,
+            optimizer_tier="hbm" if o_dev == "none" else o_dev)
+        self._residency_plan = plan
+        offload_mod.check_budget(plan, offload_enabled=(p_dev != "none"))
+        log_dist(plan.describe(), ranks=[0])
+
+    def _offload_components(self):
+        """name -> counter snapshot for every active offload store."""
+        comps = {}
+        if getattr(self, "param_swapper", None) is not None:
+            comps["param"] = self.param_swapper.stats()
+        osw = getattr(self, "optimizer_swapper", None)
+        if osw is not None and hasattr(osw, "stats"):
+            comps["optimizer"] = osw.stats()
+        return comps
+
+    def _emit_offload_telemetry(self):
+        """Fold the staging counters into per-step DELTA records:
+        ``offload_staged`` every step (bytes in/out, ring hits/misses per
+        store) and ``offload_wait`` whenever the step actually blocked on
+        staged I/O — the stall ``tools/offload_audit.py`` gates on."""
+        if self.telemetry is None:
+            return
+        comps = self._offload_components()
+        if not comps:
+            return
+        prev = self._offload_stats_prev
+        rec = {"step": self.global_steps}
+        wait_ms = 0.0
+        hits = misses = 0
+        for name, snap in comps.items():
+            last = prev.get(name, {})
+            for k in ("bytes_written", "bytes_read", "ring_hits",
+                      "ring_misses"):
+                rec[f"{name}_{k}"] = int(snap.get(k, 0)) - int(last.get(k, 0))
+            dwait = (float(snap.get("wait_s", 0.0))
+                     - float(last.get("wait_s", 0.0)))
+            rec[f"{name}_wait_ms"] = dwait * 1e3
+            wait_ms += dwait * 1e3
+            hits += rec[f"{name}_ring_hits"]
+            misses += rec[f"{name}_ring_misses"]
+            prev[name] = snap
+        rec["wait_ms"] = wait_ms
+        rec["ring_hits"] = hits
+        rec["ring_misses"] = misses
+        self.telemetry.emit("offload_staged", rec, step=self.global_steps)
+        if wait_ms > 0.0:
+            self.telemetry.emit(
+                "offload_wait",
+                {"step": self.global_steps, "wait_ms": wait_ms},
+                step=self.global_steps)
+
+    def _resync_offload_state(self):
+        """Rollback coherence for the NVMe tiers: chunks staged from the
+        abandoned trajectory must never be read back after a PR 5
+        verified-checkpoint rollback — drop them and re-persist from the
+        restored parameters.  (The optimizer swapper is re-persisted by
+        the checkpoint loader itself, overwriting its chunk keys.)"""
+        if getattr(self, "param_swapper", None) is not None:
+            self.param_swapper.invalidate()
+            self.param_swapper.swap_out_tree(self.state.params,
+                                             prefix="param", sync=False)
 
     def _configure_onebit_comm(self, name: str, opt_params: dict):
         """Enable the compensated 1-bit gradient allreduce for the onebit
@@ -839,18 +982,27 @@ class DeepSpeedEngine:
         # step (per-block gather/RS inside the scan) — that path runs over
         # the same explicit-collective machinery, so it activates cc even
         # with every quantization knob off (pure-exact wire format).
-        overlap_req = (bool(getattr(zc, "overlap_comm", False))
-                       and bool(zc.__dict__.get("overlap_comm_explicit", False))
-                       and zc.stage == 3)
+        # Parameter offload implies overlap: the offload prefetch ring IS
+        # the layered ring (slices stage host→HBM inside the slice-gather
+        # rules), so offload_param at stage 3 opts in too — unless the user
+        # explicitly declined overlap_comm.
+        explicit_overlap = (bool(getattr(zc, "overlap_comm", False))
+                            and bool(zc.__dict__.get("overlap_comm_explicit",
+                                                     False)))
+        overlap_declined = (bool(zc.__dict__.get("overlap_comm_explicit",
+                                                 False))
+                            and not bool(getattr(zc, "overlap_comm", False)))
+        offload_req = (zc.stage == 3 and zc.offload_param is not None
+                       and str(getattr(zc.offload_param, "device",
+                                       "none")).split(".")[-1] != "none")
+        overlap_req = (zc.stage == 3
+                       and (explicit_overlap
+                            or (offload_req and not overlap_declined)))
         if not (qw or qg or hpz_size > 1 or overlap_req):
             return
         if zc.stage < 3:
             log_dist("compressed collectives: zero_quantized_* / hpz need "
                      f"stage 3 (got stage {zc.stage}) — ignored", ranks=[0])
-            return
-        if zc.offload_param is not None:
-            log_dist("compressed collectives: offload_param is not combinable "
-                     "with the explicit gather programs — ignored", ranks=[0])
             return
         non_dp = [a for a in ("pipe", "expert", "seq", "tensor")
                   if int(self.mesh.shape[a]) > 1]
@@ -884,6 +1036,7 @@ class DeepSpeedEngine:
             "overlap": overlap_req,
             "exact_only": overlap_req and not (qw or qg or hpz_size > 1),
             "prefetch_depth": int(getattr(zc, "prefetch_depth", 1)),
+            "offload": offload_req,
             "layered": None,
             "n_layer": None,
         }
@@ -891,7 +1044,8 @@ class DeepSpeedEngine:
                  f"qwZ={'int%d' % self._cc['qw_bits'] if qw else 'off'}, "
                  f"qgZ={'int%d' % self._cc['qg_bits'] if qg else 'off'}, "
                  f"hpZ={'on' if hpz else 'off'}, "
-                 f"overlap={'requested' if overlap_req else 'off'}", ranks=[0])
+                 f"overlap={'requested' if overlap_req else 'off'}, "
+                 f"offload={'on' if offload_req else 'off'}", ranks=[0])
 
     def _cc_plan(self):
         """Per-leaf: which dim the ZeRO policy sharded over the cc axes
@@ -1209,7 +1363,7 @@ class DeepSpeedEngine:
             blocks_def, [None if d is None else d - 1 for d in blocks_plan])
         pf = layered_mod.LayeredPrefetch(
             slice_plan, cc, self.compute_dtype, hpz=hpz, reuse=reuse,
-            depth=cc["prefetch_depth"])
+            depth=cc["prefetch_depth"], offload=bool(cc.get("offload")))
 
         baxes = mesh_lib.BATCH_AXES
         bspec = jax.tree.map(
@@ -1805,7 +1959,9 @@ class DeepSpeedEngine:
                 getattr(self.module, "cfg", None), "n_layer", None) or 1
             emit_zero3_schedule(self.tracer, fwd_rec["t0"], fwd_rec["t1"],
                                 n_blocks=n, layered=(fwd_mode == "layered"),
-                                depth=self._cc.get("prefetch_depth", 1))
+                                depth=self._cc.get("prefetch_depth", 1),
+                                offload=(fwd_mode == "layered"
+                                         and bool(self._cc.get("offload"))))
         self.timers(FORWARD_MICRO_TIMER).stop(sync=False)
         return loss
 
@@ -1938,6 +2094,13 @@ class DeepSpeedEngine:
                 # stream the updated state back to NVMe; device copy released
                 self.optimizer_swapper.swap_out(self.state.opt_state)
                 self.state.opt_state = None
+            if self.param_swapper is not None:
+                # async per-block writeback of the updated parameter shards —
+                # the NVMe backing copy stays one step behind at most, and the
+                # staging workers overlap the writes with the next forward
+                self.param_swapper.swap_out_tree(self.state.params,
+                                                 prefix="param", sync=False)
+            self._emit_offload_telemetry()
             self._step_stats = stats
             self._advance_step_counters(stats)
             if self.watchdog is not None:
@@ -2184,6 +2347,7 @@ class DeepSpeedEngine:
         exists), host ladder state restored from the manifest, and the
         apply programs retraced if the effective LR scale changed."""
         self.reset_compression_state(reason="load_checkpoint")
+        self._resync_offload_state()
         if self.stability is None:
             return
         sd = (meta or {}).get("stability") or {}

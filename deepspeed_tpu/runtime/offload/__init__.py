@@ -1,0 +1,30 @@
+"""Tiered host/NVMe offload engine (ZeRO-Infinity, arxiv 2104.07857).
+
+Three layers:
+
+* :mod:`.staging` — async read/write queues over CRC'd chunk files
+  (background threads, double-buffered bounce buffers, capped in-flight
+  depth) — the real engine behind ``runtime/swap_tensor/``;
+* :mod:`.store` — tiered KV of leaf → {hbm, host, nvme} residency with
+  prefetch-ring hit/miss accounting and rollback-coherent invalidation;
+* :mod:`.policy` — residency planner fitting the layer window +
+  prefetch ring into an HBM budget, refusing up front instead of
+  OOMing mid-step.
+
+The engine wires these into the stage-3 layered step (see
+``runtime/engine.py`` and ``comm/compression/layered.py``): stacked
+block params live at host/NVMe, a per-block prefetch ring stages window
+k+1 host→HBM while block k computes, and optimizer state drains to NVMe
+asynchronously after each step.
+"""
+
+from .policy import (HBMBudgetError, ResidencyPlan, check_budget,
+                     leaf_bytes, plan_residency, tree_bytes)
+from .staging import StagingError, StagingFuture, StagingPool
+from .store import TIER_HBM, TIER_HOST, TIER_NVME, TieredStore
+
+__all__ = [
+    "HBMBudgetError", "ResidencyPlan", "check_budget", "leaf_bytes",
+    "plan_residency", "tree_bytes", "StagingError", "StagingFuture",
+    "StagingPool", "TIER_HBM", "TIER_HOST", "TIER_NVME", "TieredStore",
+]

@@ -1,0 +1,192 @@
+"""Residency planner: fit the layer window + prefetch ring into an HBM
+budget.
+
+The planner answers the ZeRO-Infinity question for a concrete model on a
+concrete mesh: *does this training step fit in HBM, and under which
+residency plan?*  Two footprints are compared against the per-device
+budget (``zero_optimization.hbm_budget_bytes`` or the
+``DST_HBM_BUDGET_BYTES`` env override used by the bench proof run):
+
+* **plain stage 3** — the bulk step materialises the full gathered
+  compute-dtype parameter tree on every device, plus the fp32 parameter
+  shard, the fp32 gradient-accumulator shard, and the optimizer-state
+  shards;
+* **offload + layered window** — stacked block params stay at
+  host/NVMe; only the non-block ("rest") leaves plus a ring of
+  ``prefetch_depth + 1`` per-block slices are HBM-resident at any
+  instant, and host-tier optimizer state leaves HBM entirely.
+
+If the budget admits neither plan the engine refuses up front with
+:class:`HBMBudgetError` — a deliberate refusal at init time instead of an
+allocator OOM mid-step.  All sizes are *per device*: sharded leaf bytes
+are divided by the gather group size exactly as the byte-accounting in
+``engine._cc_byte_table`` does.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class HBMBudgetError(RuntimeError):
+    """The configured step cannot fit the HBM budget under any available
+    residency plan (raise instead of OOMing mid-step)."""
+
+
+def leaf_bytes(shape: Tuple[int, ...], dtype) -> int:
+    return int(math.prod(shape) or 1) * int(np.dtype(dtype).itemsize)
+
+
+def tree_bytes(tree, itemsize: Optional[int] = None) -> int:
+    """Total bytes of a pytree of ShapeDtypeStruct/ndarray-likes; with
+    ``itemsize`` the dtype is overridden (compute-dtype sizing)."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        n = int(math.prod(leaf.shape) or 1)
+        total += n * (itemsize if itemsize is not None
+                      else int(np.dtype(leaf.dtype).itemsize))
+    return total
+
+
+@dataclass
+class ResidencyPlan:
+    """The planner's verdict plus the numbers behind it (all bytes are
+    per device)."""
+    budget_bytes: int
+    plain_peak_bytes: int
+    window_peak_bytes: int
+    fits_plain: bool
+    fits_window: bool
+    n_layer: int
+    prefetch_depth: int
+    params_tier: str = "hbm"            # hbm | host | nvme
+    optimizer_tier: str = "hbm"
+    notes: Tuple[str, ...] = field(default_factory=tuple)
+
+    @property
+    def fits(self) -> bool:
+        return self.fits_plain or self.fits_window
+
+    def describe(self) -> str:
+        mb = 1.0 / (1 << 20)
+        return (f"HBM budget {self.budget_bytes * mb:.1f} MiB: "
+                f"plain stage-3 peak {self.plain_peak_bytes * mb:.1f} MiB "
+                f"({'fits' if self.fits_plain else 'over'}), "
+                f"offload window peak {self.window_peak_bytes * mb:.1f} MiB "
+                f"({'fits' if self.fits_window else 'over'}; "
+                f"L={self.n_layer}, depth={self.prefetch_depth}, "
+                f"params@{self.params_tier}, opt@{self.optimizer_tier})")
+
+    def as_record(self) -> Dict[str, Any]:
+        return {"budget_bytes": self.budget_bytes,
+                "plain_peak_bytes": self.plain_peak_bytes,
+                "window_peak_bytes": self.window_peak_bytes,
+                "fits_plain": self.fits_plain,
+                "fits_window": self.fits_window,
+                "n_layer": self.n_layer,
+                "prefetch_depth": self.prefetch_depth,
+                "params_tier": self.params_tier,
+                "optimizer_tier": self.optimizer_tier}
+
+
+def _block_and_rest(params) -> Tuple[Any, Any, int]:
+    """Split a param tree into the stacked ``blocks`` subtree and the
+    rest, returning also the layer count (0 when not stacked)."""
+    if not isinstance(params, dict) or "blocks" not in params:
+        return None, params, 0
+    blocks = params["blocks"]
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+    import jax
+    leaves = jax.tree.leaves(blocks)
+    n_layer = int(leaves[0].shape[0]) if leaves and leaves[0].shape else 0
+    return blocks, rest, n_layer
+
+
+def plan_residency(params,
+                   opt_state,
+                   budget_bytes: int,
+                   world: int,
+                   compute_itemsize: int,
+                   prefetch_depth: int = 2,
+                   params_tier: str = "hbm",
+                   optimizer_tier: str = "hbm",
+                   opt_slots: int = 2) -> ResidencyPlan:
+    """Size the plain-stage-3 peak and the offload layer-window peak.
+
+    ``params`` / ``opt_state`` are pytrees of shape/dtype carriers
+    (``jax.eval_shape`` output or live arrays).  ``world`` is the gather
+    group size (ZeRO-3 shard denominator).  ``opt_state=None`` sizes the
+    optimizer as ``opt_slots`` fp32 copies of the param shard (Adam m+v).
+    """
+    world = max(1, int(world))
+    notes = []
+
+    param_total = tree_bytes(params)                      # fp32 master
+    gathered = tree_bytes(params, itemsize=compute_itemsize)
+    shard = param_total // world
+    grads_shard = param_total // world                    # fp32 grad acc
+    if opt_state is not None:
+        opt_shard = tree_bytes(opt_state) // world
+    else:
+        opt_shard = opt_slots * shard
+        notes.append(f"optimizer sized as {opt_slots}x fp32 param shard")
+
+    # plain stage 3: everything gathered at once + shards + grads + opt
+    plain_peak = gathered + shard + grads_shard + opt_shard
+
+    blocks, rest, n_layer = _block_and_rest(params)
+    depth = max(1, int(prefetch_depth))
+    if blocks is not None and n_layer > 0:
+        block_gathered = tree_bytes(blocks, itemsize=compute_itemsize)
+        per_slice = block_gathered // n_layer
+        rest_gathered = tree_bytes(rest, itemsize=compute_itemsize)
+        window = rest_gathered + min(depth + 1, n_layer) * per_slice
+    else:
+        window = gathered
+        notes.append("model not stacked: no layer window to offload")
+
+    window_peak = window + grads_shard + shard
+    if optimizer_tier == "hbm":
+        window_peak += opt_shard
+    if params_tier == "hbm":
+        notes.append("params_tier=hbm: window plan assumes host residency")
+
+    plan = ResidencyPlan(
+        budget_bytes=int(budget_bytes),
+        plain_peak_bytes=int(plain_peak),
+        window_peak_bytes=int(window_peak),
+        fits_plain=plain_peak <= budget_bytes,
+        fits_window=(window_peak <= budget_bytes
+                     and blocks is not None and n_layer > 0
+                     and params_tier != "hbm"),
+        n_layer=n_layer,
+        prefetch_depth=depth,
+        params_tier=params_tier,
+        optimizer_tier=optimizer_tier,
+        notes=tuple(notes))
+    return plan
+
+
+def check_budget(plan: ResidencyPlan, offload_enabled: bool) -> ResidencyPlan:
+    """Enforce the plan: refuse configurations that cannot fit.
+
+    Without offload only the plain peak counts; with offload the window
+    plan may rescue it.  Raises :class:`HBMBudgetError` on refusal."""
+    if plan.budget_bytes <= 0:
+        return plan
+    if not offload_enabled:
+        if not plan.fits_plain:
+            raise HBMBudgetError(
+                "plain stage-3 step exceeds the HBM budget — "
+                + plan.describe()
+                + " — enable zero_optimization.offload_param/"
+                  "offload_optimizer to train beyond HBM")
+        return plan
+    if not plan.fits:
+        raise HBMBudgetError(
+            "even the offloaded layer window exceeds the HBM budget — "
+            + plan.describe())
+    return plan

@@ -1,0 +1,185 @@
+"""Tiered residency store: leaf → {hbm, host, nvme}.
+
+One :class:`TieredStore` owns the host cache and the NVMe chunk backing
+for a tree of leaves (parameters or optimizer state).  Residency per key
+is the set of tiers currently holding a valid copy:
+
+* ``hbm``  — the live ``jax.Array`` the compiled step consumes (the store
+  does not hold it; the engine reports it via ``mark_hbm``);
+* ``host`` — a pinned numpy copy in the store's LRU cache, bounded by
+  ``max_in_cpu`` bytes (the ``offload_param.max_in_cpu`` knob);
+* ``nvme`` — a CRC'd chunk file owned by the :class:`StagingPool`.
+
+``put`` is write-through (host cache + async NVMe write); ``prefetch``
+issues async reads for the next window; ``get`` joins them — a read that
+finished before it was needed is a **ring hit**, one still in flight or
+never issued is a **ring miss** whose blocking time is the stall the
+audit tool gates on.  ``invalidate`` drops every cached copy so a PR 5
+rollback can re-persist from the restored trajectory (stale NVMe bytes
+must never survive a rollback).
+"""
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from .staging import StagingError, StagingFuture, StagingPool
+
+TIER_HBM = "hbm"
+TIER_HOST = "host"
+TIER_NVME = "nvme"
+
+
+class TieredStore:
+    """Host-LRU + NVMe-backed key/value store for offloaded leaves."""
+
+    def __init__(self, staging: StagingPool,
+                 max_in_cpu: Optional[int] = None):
+        self.staging = staging
+        # None = unbounded host cache; 0 = drop host copies as soon as the
+        # NVMe write lands (every read then exercises the staged tier)
+        self.max_in_cpu = None if max_in_cpu is None else int(max_in_cpu)
+        self._host: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._host_bytes = 0
+        self._hbm: set = set()
+        self._pending_reads: Dict[str, StagingFuture] = {}
+        self._pending_writes: Dict[str, StagingFuture] = {}
+        self._lock = threading.RLock()
+        self.ring_hits = 0
+        self.ring_misses = 0
+
+    # ---- write path ---------------------------------------------------- #
+    def put(self, key: str, array, write_through: bool = True):
+        """Install a host copy and (by default) start the async NVMe
+        write.  The host copy is what ``get`` serves while the write
+        drains, so the caller never waits here."""
+        host = np.asarray(array)
+        with self._lock:
+            self._host_insert(key, host)
+            if write_through:
+                self._pending_writes[key] = self.staging.write(key, host)
+            self._evict_to_budget()
+
+    def _host_insert(self, key: str, host: np.ndarray):
+        old = self._host.pop(key, None)
+        if old is not None:
+            self._host_bytes -= old.nbytes
+        self._host[key] = host
+        self._host_bytes += host.nbytes
+
+    def _evict_to_budget(self):
+        """LRU-drop host copies whose NVMe write has landed until the
+        cache fits ``max_in_cpu``.  Copies without durable backing are
+        never dropped — correctness beats the budget."""
+        if self.max_in_cpu is None:
+            return
+        for key in list(self._host):
+            if self._host_bytes <= self.max_in_cpu:
+                break
+            fut = self._pending_writes.get(key)
+            if fut is not None and not fut.done:
+                continue
+            if self.staging.chunk_info(key) is None:
+                continue
+            dropped = self._host.pop(key)
+            self._host_bytes -= dropped.nbytes
+            self._pending_writes.pop(key, None)
+
+    # ---- read path ----------------------------------------------------- #
+    def prefetch(self, keys: Iterable[str]):
+        """Issue async NVMe reads for keys not already host-resident."""
+        with self._lock:
+            for key in keys:
+                if key in self._host or key in self._pending_reads:
+                    continue
+                if self.staging.chunk_info(key) is None:
+                    continue
+                self._pending_reads[key] = self.staging.read(key)
+
+    def get(self, key: str) -> np.ndarray:
+        """Return the host copy, joining a prefetch or falling back to a
+        synchronous NVMe read.  Hit/miss accounting feeds the audit."""
+        with self._lock:
+            host = self._host.get(key)
+            if host is not None:
+                self._host.move_to_end(key)
+                self.ring_hits += 1
+                return host
+            fut = self._pending_reads.pop(key, None)
+        if fut is not None:
+            was_done = fut.done
+            host = fut.result()
+        else:
+            # a write may still be in flight for this key; make it durable
+            # before reading it back
+            with self._lock:
+                wfut = self._pending_writes.get(key)
+            if wfut is not None:
+                wfut.result()
+            was_done = False
+            host = self.staging.read_sync(key)
+        with self._lock:
+            if was_done:
+                self.ring_hits += 1
+            else:
+                self.ring_misses += 1
+            self._host_insert(key, host)
+            self._evict_to_budget()
+        return host
+
+    # ---- residency / coherence ----------------------------------------- #
+    def mark_hbm(self, key: str, resident: bool = True):
+        with self._lock:
+            (self._hbm.add if resident else self._hbm.discard)(key)
+
+    def residency(self, key: str) -> Tuple[str, ...]:
+        with self._lock:
+            tiers = []
+            if key in self._hbm:
+                tiers.append(TIER_HBM)
+            if key in self._host:
+                tiers.append(TIER_HOST)
+            if self.staging.chunk_info(key) is not None:
+                tiers.append(TIER_NVME)
+            return tuple(tiers)
+
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._host_bytes
+
+    def drain(self):
+        """Block until every pending write is durable (and checked)."""
+        with self._lock:
+            writes = list(self._pending_writes.items())
+        for key, fut in writes:
+            fut.result()
+        with self._lock:
+            for key, _ in writes:
+                self._pending_writes.pop(key, None)
+            self._evict_to_budget()
+        self.staging.sync_manifest()
+
+    def invalidate(self):
+        """Drop every cached/staged copy (rollback coherence): after a
+        PR 5 verified-checkpoint rollback the engine re-persists from the
+        restored state, so anything staged from the abandoned trajectory
+        must not be readable."""
+        self.drain()
+        with self._lock:
+            for key in list(self.staging.keys()):
+                self.staging.delete(key)
+            self._host.clear()
+            self._host_bytes = 0
+            self._pending_reads.clear()
+            self._pending_writes.clear()
+
+    def stats(self) -> Dict[str, Any]:
+        snap = self.staging.snapshot()
+        with self._lock:
+            snap.update(ring_hits=self.ring_hits,
+                        ring_misses=self.ring_misses,
+                        host_bytes=self._host_bytes,
+                        host_keys=len(self._host))
+        return snap

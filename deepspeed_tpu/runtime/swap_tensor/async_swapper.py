@@ -1,69 +1,95 @@
 """Asynchronous tensor swapper.
 
 Reference: ``runtime/swap_tensor/async_swapper.py:18``
-(``AsyncTensorSwapper``): stream tensors to swap files through the native
-aio engine without blocking the trainer; ``swap_out`` enqueues,
-``synchronize`` joins.  Buffers are host numpy copies (for ``jax.Array``
-inputs the device→host transfer happens on enqueue; the disk write then
-overlaps the next training work).
+(``AsyncTensorSwapper``): stream tensors to swap files without blocking
+the trainer; ``swap_out`` enqueues, ``synchronize`` joins.
+
+PR 10 replaced the AIOHandle-backed stub with the real offload engine:
+requests run on :class:`deepspeed_tpu.runtime.offload.StagingPool`
+worker threads (device→host DMA happens in the worker, so enqueue
+returns immediately), file I/O is double-buffered through the bounce
+pool, in-flight depth is capped at the aio ``queue_depth``, and every
+chunk is CRC-verified on read.  The integer request-id surface is kept
+for API compatibility; ids map to staging futures.
 """
 
 import os
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import numpy as np
 
-from deepspeed_tpu.ops.aio import AIOHandle
+from deepspeed_tpu.runtime.offload.staging import StagingFuture, StagingPool
 from deepspeed_tpu.runtime.swap_tensor.aio_config import get_aio_config
 
 
 def swap_path(folder: str, key: str) -> str:
-    return os.path.join(folder, f"{key}.swp")
+    return os.path.join(folder, f"{key}.chunk")
 
 
 class AsyncTensorSwapper:
 
     def __init__(self, aio_config: Optional[Dict] = None,
-                 swap_folder: str = "/tmp/dst_swap", handle: Optional[AIOHandle] = None):
+                 swap_folder: str = "/tmp/dst_swap", handle=None,
+                 buffer_count: int = 2):
         cfg = get_aio_config({"aio": aio_config or {}})
         self.swap_folder = swap_folder
-        os.makedirs(swap_folder, exist_ok=True)
-        self.handle = handle or AIOHandle(
-            block_size=cfg["block_size"], queue_depth=cfg["queue_depth"],
-            single_submit=cfg["single_submit"],
-            overlap_events=cfg["overlap_events"],
-            num_threads=cfg["thread_count"],
-            use_o_direct=cfg["use_o_direct"])
-        # in-flight buffers must stay alive until the write completes
-        self._inflight: Dict[int, np.ndarray] = {}
+        self.handle = handle            # legacy surface; I/O goes via pool
+        self.pool = StagingPool(
+            swap_folder,
+            buffer_count=buffer_count,
+            buffer_size=cfg["block_size"],
+            queue_depth=cfg["queue_depth"],
+            thread_count=cfg["thread_count"])
+        self._inflight: Dict[int, StagingFuture] = {}
+        self._next_rid = 0
         self.swap_count = 0
         self.bytes_swapped = 0
 
-    def swap_out(self, key: str, array) -> int:
-        """Enqueue an async write of ``array`` under ``key``; returns the
-        request id."""
-        host = np.ascontiguousarray(np.asarray(array))
-        rid = self.handle.async_pwrite(host, swap_path(self.swap_folder, key))
-        self._inflight[rid] = host        # pin until joined
-        self.swap_count += 1
-        self.bytes_swapped += host.nbytes
-        return rid
+    def _rid(self, fut: StagingFuture) -> int:
+        self._next_rid += 1
+        self._inflight[self._next_rid] = fut
+        return self._next_rid
 
-    def swap_in(self, key: str, shape, dtype) -> np.ndarray:
-        """Synchronous read of a previously swapped tensor."""
-        out = np.empty(shape, dtype)
-        self.handle.pread(out, swap_path(self.swap_folder, key))
+    def swap_out(self, key: str, array) -> int:
+        """Enqueue an async CRC'd write of ``array`` under ``key``;
+        returns the request id."""
+        self.swap_count += 1
+        self.bytes_swapped += int(getattr(array, "nbytes",
+                                          np.asarray(array).nbytes))
+        return self._rid(self.pool.write(key, array))
+
+    def swap_in(self, key: str, shape=None, dtype=None) -> np.ndarray:
+        """Synchronous verified read of a previously swapped tensor.
+        Shape/dtype come from the staging manifest; the arguments are
+        kept for the legacy call shape and cross-checked when given."""
+        out = self.pool.read_sync(key)
+        if shape is not None and tuple(out.shape) != tuple(shape):
+            raise ValueError(f"swap_in {key!r}: staged shape {out.shape} "
+                             f"!= requested {tuple(shape)}")
+        if dtype is not None and out.dtype != np.dtype(dtype):
+            raise ValueError(f"swap_in {key!r}: staged dtype {out.dtype} "
+                             f"!= requested {np.dtype(dtype)}")
         return out
 
-    def async_swap_in(self, key: str, shape, dtype):
-        out = np.empty(shape, dtype)
-        rid = self.handle.async_pread(out, swap_path(self.swap_folder, key))
-        self._inflight[rid] = out
-        return rid, out
+    def async_swap_in(self, key: str, shape=None, dtype=None):
+        """Start an async read; returns ``(request_id, future)`` — join
+        with ``synchronize(rid)`` then collect via ``fetch(rid)``, or
+        call ``future.result()`` directly."""
+        fut = self.pool.read(key)
+        return self._rid(fut), fut
+
+    def fetch(self, request_id: int) -> np.ndarray:
+        """Join one read request and return its array."""
+        fut = self._inflight.pop(request_id)
+        return fut.result()
 
     def synchronize(self, request_id: Optional[int] = None):
-        self.handle.wait(request_id)
         if request_id is not None:
-            self._inflight.pop(request_id, None)
-        else:
-            self._inflight.clear()
+            fut = self._inflight.pop(request_id, None)
+            if fut is not None:
+                fut.result()
+            return
+        for fut in list(self._inflight.values()):
+            fut.result()
+        self._inflight.clear()
+        self.pool.sync_manifest()

@@ -6,11 +6,14 @@ on NVMe between steps; each step swaps the needed partitions in, updates,
 and swaps them back out, overlapping the write-back with the next
 forward/backward.
 
-Engine contract here: ``swap_out`` after ``step()`` (async — returns
-immediately, device buffers released by dropping references),
+Engine contract here: ``swap_out`` after ``step()`` (async by default —
+per-block chunks drain on the staging workers while the next forward
+runs; device buffers are released by dropping references),
 ``swap_in(shardings)`` right before the next update.  The pipelined
 variant (reference ``pipelined_optimizer_swapper.py:51``) is the same
-object driven with ``prefetch()`` at forward time.
+object driven with ``prefetch()`` at forward time.  Stacked ``blocks``
+leaves are chunked per block so the writeback and the prefetch ring both
+operate at layer-window granularity.
 """
 
 from typing import Any, Dict, Optional
@@ -19,25 +22,40 @@ from deepspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import (
     AsyncPartitionedParameterSwapper)
 
 
+def _blocks_chunking(key: str) -> bool:
+    return "blocks" in key.split("__")
+
+
 class PartitionedOptimizerSwapper:
 
     PREFIX = "opt"
 
-    def __init__(self, swap_folder: str, aio_config: Optional[Dict] = None):
-        self._swapper = AsyncPartitionedParameterSwapper(swap_folder, aio_config)
+    def __init__(self, swap_folder: str, aio_config: Optional[Dict] = None,
+                 max_in_cpu: Optional[int] = None, pipeline_write: bool = False):
+        # pipeline_write defaults off so ``swapped_bytes()`` is deterministic
+        # right after ``swap_out`` (the engine opts into async writeback and
+        # reads counters only at telemetry folds)
+        self._swapper = AsyncPartitionedParameterSwapper(
+            swap_folder, aio_config, max_in_cpu=max_in_cpu,
+            chunk_paths=_blocks_chunking)
         self._template = None       # shapes/dtypes pytree (host copy of state)
+        self._pipeline_write = pipeline_write
 
     @property
     def is_swapped(self) -> bool:
         return self._template is not None
 
     def swap_out(self, opt_state) -> None:
-        """Persist the whole optimizer state to swap files; keeps only an
-        abstract template in memory."""
+        """Persist the whole optimizer state to CRC'd swap chunks; keeps
+        only an abstract template in memory.  With ``pipeline_write``
+        the per-block writes drain asynchronously on the staging workers
+        (overlapping the next forward); the store's write-through host
+        copy keeps reads correct while they land."""
         import jax
         self._template = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), opt_state)
-        self._swapper.swap_out_tree(opt_state, prefix=self.PREFIX)
+        self._swapper.swap_out_tree(opt_state, prefix=self.PREFIX,
+                                    sync=not self._pipeline_write)
 
     def prefetch(self) -> None:
         """Begin async reads (call at forward time to overlap with compute)."""
@@ -53,6 +71,18 @@ class PartitionedOptimizerSwapper:
 
     def swapped_bytes(self) -> int:
         return self._swapper.swapped_bytes()
+
+    def stats(self) -> Dict[str, Any]:
+        return self._swapper.stats()
+
+    def drain(self) -> None:
+        self._swapper.store.drain()
+
+    def invalidate(self) -> None:
+        """Rollback coherence: drop staged chunks from the abandoned
+        trajectory; the engine re-persists from the restored state."""
+        self._swapper.invalidate()
+        self._template = None
 
 
 # reference-name alias: the separate class there only changes the driving

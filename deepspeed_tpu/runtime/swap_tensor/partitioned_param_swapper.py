@@ -3,20 +3,29 @@
 Reference: ``runtime/swap_tensor/partitioned_param_swapper.py:36``
 (``AsyncPartitionedParameterSwapper``): maps partitioned parameters to
 swap files, gathers/releases them around use, keeps a bounded pool of
-staging buffers.  Functional recast: a pytree's leaves swap out to one
-file each; ``swap_in_tree`` brings them back (optionally async with
-prefetch), re-placing onto the caller's shardings.
+staging buffers.
+
+PR 10 recast this as a pytree adapter over the tiered offload store
+(:mod:`deepspeed_tpu.runtime.offload`): each leaf (or, for stacked
+``blocks`` leaves, each per-block slice along axis 0) becomes one CRC'd
+chunk with host-LRU caching bounded by ``max_in_cpu`` bytes.
+``prefetch_tree`` issues the async reads of the next window;
+``swap_in_tree`` joins them — reads that landed before they were needed
+count as prefetch-ring hits, the rest as misses whose blocking time the
+offload audit gates on.  Per-block chunking is what lets the optimizer
+writeback drain block-by-block after each update instead of as one
+monolithic file.
 """
 
-import os
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 import jax
 
-from deepspeed_tpu.runtime.swap_tensor.async_swapper import (AsyncTensorSwapper,
-                                                             swap_path)
+from deepspeed_tpu.runtime.offload.staging import StagingPool
+from deepspeed_tpu.runtime.offload.store import TieredStore
+from deepspeed_tpu.runtime.swap_tensor.aio_config import get_aio_config
 
 
 def _leaf_key(path) -> str:
@@ -26,58 +35,101 @@ def _leaf_key(path) -> str:
 
 class AsyncPartitionedParameterSwapper:
 
-    def __init__(self, swap_folder: str, aio_config: Optional[Dict] = None):
-        self.swapper = AsyncTensorSwapper(aio_config, swap_folder)
+    def __init__(self, swap_folder: str, aio_config: Optional[Dict] = None,
+                 buffer_count: int = 2, max_in_cpu: Optional[int] = None,
+                 chunk_paths: Optional[Callable[[str], bool]] = None):
+        cfg = get_aio_config({"aio": aio_config or {}})
         self.swap_folder = swap_folder
-        self._meta: Dict[str, Any] = {}      # key -> (shape, dtype)
-        self._prefetch: Dict[str, Any] = {}  # key -> (request id, buffer)
+        self.pool = StagingPool(
+            swap_folder,
+            buffer_count=buffer_count,
+            buffer_size=cfg["block_size"],
+            queue_depth=cfg["queue_depth"],
+            thread_count=cfg["thread_count"])
+        self.store = TieredStore(self.pool, max_in_cpu=max_in_cpu)
+        # key -> (shape, dtype, n_chunks); n_chunks == 0 means unchunked
+        self._meta: Dict[str, Any] = {}
+        self._chunk_paths = chunk_paths
+
+    def _chunked(self, key: str, host_shape) -> int:
+        """Chunk count along axis 0 for this leaf (0 = whole-leaf file)."""
+        if (self._chunk_paths is not None and self._chunk_paths(key)
+                and len(host_shape) >= 1 and host_shape[0] > 1):
+            return int(host_shape[0])
+        return 0
+
+    @staticmethod
+    def _chunk_key(key: str, i: int) -> str:
+        return f"{key}__blk{i}"
 
     # ---- whole-pytree surface ----------------------------------------- #
-    def swap_out_tree(self, tree, prefix: str = "p") -> None:
-        """Write every array leaf to its swap file (async), record metadata,
-        and join before returning (the tree's device memory may then be
-        released by the caller)."""
+    def swap_out_tree(self, tree, prefix: str = "p", sync: bool = True) -> None:
+        """Write every array leaf (async) through the tiered store,
+        recording metadata; with ``sync`` the writes are joined before
+        returning so the caller may release device memory."""
         for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
             key = f"{prefix}__{_leaf_key(path)}"
             host = np.asarray(leaf)
-            self._meta[key] = (host.shape, host.dtype)
-            self.swapper.swap_out(key, host)
-        self.swapper.synchronize()
+            n_chunks = self._chunked(key, host.shape)
+            self._meta[key] = (host.shape, host.dtype, n_chunks)
+            if n_chunks:
+                for i in range(n_chunks):
+                    self.store.put(self._chunk_key(key, i), host[i])
+            else:
+                self.store.put(key, host)
+        if sync:
+            self.store.drain()
 
-    def prefetch_tree(self, tree_def_like, prefix: str = "p") -> None:
-        """Start async reads for every leaf (reference prefetch path)."""
+    def _keys_for(self, tree_def_like, prefix: str):
         for path, _ in jax.tree_util.tree_leaves_with_path(tree_def_like):
             key = f"{prefix}__{_leaf_key(path)}"
-            shape, dtype = self._meta[key]
-            self._prefetch[key] = self.swapper.async_swap_in(key, shape, dtype)
+            _, _, n_chunks = self._meta[key]
+            if n_chunks:
+                for i in range(n_chunks):
+                    yield self._chunk_key(key, i)
+            else:
+                yield key
+
+    def prefetch_tree(self, tree_def_like, prefix: str = "p") -> None:
+        """Start async reads for every chunk (the prefetch ring's
+        host←NVMe half — call while compute overlaps)."""
+        self.store.prefetch(self._keys_for(tree_def_like, prefix))
 
     def swap_in_tree(self, tree_def_like, shardings=None, prefix: str = "p"):
-        """Read every leaf back (joining prefetches when present) and
-        rebuild the pytree; with ``shardings``, leaves are device_put."""
+        """Read every leaf back (joining prefetches) and rebuild the
+        pytree; with ``shardings``, leaves are device_put."""
         leaves = []
         paths = jax.tree_util.tree_leaves_with_path(tree_def_like)
         shard_leaves = (jax.tree_util.tree_leaves(shardings)
                         if shardings is not None else [None] * len(paths))
         for (path, _), sh in zip(paths, shard_leaves):
             key = f"{prefix}__{_leaf_key(path)}"
-            if key in self._prefetch:
-                rid, buf = self._prefetch.pop(key)
-                self.swapper.synchronize(rid)
+            shape, dtype, n_chunks = self._meta[key]
+            if n_chunks:
+                buf = np.stack([self.store.get(self._chunk_key(key, i))
+                                for i in range(n_chunks)])
+                buf = buf.reshape(shape).astype(dtype, copy=False)
             else:
-                shape, dtype = self._meta[key]
-                buf = self.swapper.swap_in(key, shape, dtype)
+                buf = self.store.get(key)
             leaves.append(jax.device_put(buf, sh) if sh is not None else buf)
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(tree_def_like), leaves)
 
     def swapped_bytes(self) -> int:
-        return self.swapper.bytes_swapped
+        return self.pool.snapshot()["bytes_written"]
+
+    def stats(self) -> Dict[str, Any]:
+        return self.store.stats()
+
+    def invalidate(self):
+        """Drop every staged chunk + host copy (rollback coherence)."""
+        self.store.invalidate()
+        self._meta.clear()
 
     def remove(self, prefix: str = "p"):
         for key in list(self._meta):
             if key.startswith(prefix + "__"):
-                try:
-                    os.remove(swap_path(self.swap_folder, key))
-                except OSError:
-                    pass
-                del self._meta[key]
+                _, _, n_chunks = self._meta.pop(key)
+                for k in ([self._chunk_key(key, i) for i in range(n_chunks)]
+                          if n_chunks else [key]):
+                    self.pool.delete(k)

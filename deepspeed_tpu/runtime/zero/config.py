@@ -117,7 +117,16 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     prefetch_depth: int = Field(1, ge=1)
     """Layered stage-3 only: how many block-parameter slices the scan keeps
     in flight ahead of the block currently computing.  1 = classic double
-    buffering (gather block ``i+1`` while block ``i`` computes)."""
+    buffering (gather block ``i+1`` while block ``i`` computes).  The
+    offload prefetch ring (host→HBM staging) reuses the same knob."""
+
+    hbm_budget_bytes: int = Field(0, ge=0)
+    """Per-device HBM budget the residency planner must fit (0 = off).
+    When set, engine init sizes the plain stage-3 peak and the offloaded
+    layer window against it (``runtime/offload/policy.py``) and refuses —
+    :class:`~deepspeed_tpu.runtime.offload.HBMBudgetError` — instead of
+    OOMing mid-step.  The ``DST_HBM_BUDGET_BYTES`` env var overrides it
+    (the bench OOM-proof run uses this)."""
 
     @model_validator(mode="after")
     def quantization_valid(self):

@@ -289,7 +289,8 @@ def maybe_span(name: str, **args):
 # ZeRO-3 schedule lanes — the compute/communication overlap record
 # --------------------------------------------------------------------------- #
 def emit_zero3_schedule(tracer: Tracer, t0_ns: int, t1_ns: int,
-                        n_blocks: int, layered: bool, depth: int = 1):
+                        n_blocks: int, layered: bool, depth: int = 1,
+                        offload: bool = False):
     """Emit synthetic ``zero3.comm`` / ``zero3.compute`` lanes describing
     the stage-3 step's dependence structure inside the measured fwd window.
 
@@ -316,6 +317,13 @@ def emit_zero3_schedule(tracer: Tracer, t0_ns: int, t1_ns: int,
 
     if layered:
         for i in range(L):
+            if offload:
+                # the host→HBM stage of slice i rides the same ring slot
+                # as its gather (it feeds the gather's wire bytes), hidden
+                # under block i-depth's compute like the collective
+                tracer.add_span("offload.stage", at(i), at(i + 1),
+                                track="offload.stage", kind="comm",
+                                block=i, depth=depth)
             tracer.add_span("zero3.gather", at(i), at(i + 1),
                             track="zero3.comm", kind="comm", block=i,
                             depth=depth)

@@ -1,0 +1,112 @@
+"""``tools/offload_audit.py`` unit tests — synthetic telemetry JSONL in,
+JSON report + exit code out (same shell-tool discipline as
+``tests/unit/comm/test_comm_audit.py``)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_mod = _load_tool("offload_audit")
+audit = _mod.audit
+load_records = _mod.load_records
+main = _mod.main
+
+
+def _staged(step, wait_ms=0.0, hits=4, misses=0, written=1000, read=500):
+    return {"kind": "offload_staged", "schema": 1, "step": step,
+            "wait_ms": wait_ms, "ring_hits": hits, "ring_misses": misses,
+            "param_bytes_written": written, "param_bytes_read": read,
+            "param_ring_hits": hits, "param_ring_misses": misses,
+            "param_wait_ms": wait_ms}
+
+
+def _step(step, ms=100.0):
+    return {"kind": "step", "schema": 1, "step": step, "step_time_ms": ms}
+
+
+def _write(tmp_path, records, junk=False):
+    p = tmp_path / "run.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "schema", "version": 1}) + "\n")
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+        if junk:
+            f.write('{"kind": "offload_sta')     # torn tail from a crash
+    return str(p)
+
+
+class TestLoad:
+    def test_collects_staged_and_step_times(self, tmp_path):
+        p = _write(tmp_path, [_staged(1), _step(1), _staged(2), _step(2)],
+                   junk=True)
+        staged, step_ms, err = load_records(p)
+        assert err is None
+        assert len(staged) == 2 and step_ms == {1: 100.0, 2: 100.0}
+
+    def test_no_staged_records_is_usage_error(self, tmp_path):
+        p = _write(tmp_path, [_step(1)])
+        _, _, err = load_records(p)
+        assert "no offload_staged" in err
+
+    def test_missing_file(self, tmp_path):
+        _, _, err = load_records(str(tmp_path / "nope.jsonl"))
+        assert err is not None
+
+
+class TestAudit:
+    def test_stall_frac_over_matched_steps(self, tmp_path):
+        staged = [_staged(1, wait_ms=10.0), _staged(2, wait_ms=30.0),
+                  _staged(3, wait_ms=999.0)]      # step 3 has no step record
+        report = audit(staged, {1: 100.0, 2: 100.0})
+        assert report["stall_frac"] == pytest.approx(40.0 / 200.0)
+        assert report["steps_matched"] == 2 and report["steps_audited"] == 3
+
+    def test_per_store_fold_and_hit_rate(self):
+        report = audit([_staged(1, hits=3, misses=1),
+                        _staged(2, hits=5, misses=1)], {})
+        assert report["stores"]["param"]["bytes_written"] == 2000
+        assert report["hit_rate"] == pytest.approx(8 / 10)
+        assert report["stores"]["param"]["hit_rate"] == pytest.approx(8 / 10)
+
+    def test_no_io_counts_as_perfect(self):
+        report = audit([_staged(1, hits=0, misses=0)], {})
+        assert report["hit_rate"] == 1.0 and report["stall_frac"] == 0.0
+
+
+class TestMain:
+    def test_pass_and_json_out(self, tmp_path, capsys):
+        p = _write(tmp_path, [_staged(1, wait_ms=5.0), _step(1)])
+        out = tmp_path / "report.json"
+        assert main([p, "--max-stall-frac", "0.5", "--json", str(out)]) == 0
+        report = json.loads(out.read_text())
+        assert report["ok"] is True
+        assert json.loads(capsys.readouterr().out)["stall_frac"] == 0.05
+
+    def test_stall_gate_fails(self, tmp_path, capsys):
+        p = _write(tmp_path, [_staged(1, wait_ms=80.0), _step(1)])
+        assert main([p, "--max-stall-frac", "0.5"]) == 1
+        assert json.loads(capsys.readouterr().out)["ok"] is False
+
+    def test_hit_rate_gate_fails(self, tmp_path, capsys):
+        p = _write(tmp_path, [_staged(1, hits=1, misses=9), _step(1)])
+        assert main([p, "--min-hit-rate", "0.5"]) == 1
+        capsys.readouterr()
+
+    def test_usage_error_exit_2(self, tmp_path, capsys):
+        p = _write(tmp_path, [_step(1)])
+        assert main([p]) == 2
+        assert "error" in json.loads(capsys.readouterr().err)

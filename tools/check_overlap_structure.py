@@ -21,9 +21,16 @@ depends on:
 * the scan-model files (``models/gpt.py``, ``models/bert.py``) must
   contain no gather-primitive call at all: model code reaches parameters
   only through the prefetch context (``zero_layered.current_prefetch``).
+* (PR 10) the same scopes must contain no host→device transfer call
+  (``device_put`` / ``_stage_to_device``): under offload the block
+  leaves live in host memory, and a whole-tree transfer before the scan
+  silently reverts the offload prefetch ring to a bulk upload the same
+  way a whole-tree gather reverts the overlap.  Per-slice staging lives
+  inside the ``custom_vjp`` impls in ``comm/compression/layered.py`` —
+  the one sanctioned site, outside every checked scope.
 
-One escape hatch: a line carrying the pragma string
-``layered-gather ok`` is sanctioned.
+Escape hatches: a line carrying the pragma string ``layered-gather ok``
+sanctions a gather; ``offload-transfer ok`` sanctions a transfer.
 
 Run directly (``python tools/check_overlap_structure.py``) or from the
 suite (``tests/unit/comm/test_layered_overlap.py``).  Exit 0 = clean.
@@ -38,11 +45,16 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 PRAGMA = "layered-gather ok"
+TRANSFER_PRAGMA = "offload-transfer ok"
 
 GATHER_NAMES = frozenset({
     "all_gather", "all_gather_invariant", "quantized_all_gather",
     "hierarchical_gather", "fast_regather", "slow_gather_secondary",
 })
+
+# Host→device transfer entry points: any of these on a whole (stacked)
+# block tree inside a checked scope defeats the offload prefetch ring.
+TRANSFER_NAMES = frozenset({"device_put", "_stage_to_device"})
 
 # (file, scope): scope None = whole file, else only the named function's body
 CHECKED_SCOPES = (
@@ -72,8 +84,8 @@ def _find_function(tree, name):
 def _violations_in_scope(src, filename, scope):
     lines = src.splitlines()
 
-    def sanctioned(lineno):
-        return 0 < lineno <= len(lines) and PRAGMA in lines[lineno - 1]
+    def sanctioned(lineno, pragma):
+        return 0 < lineno <= len(lines) and pragma in lines[lineno - 1]
 
     tree = ast.parse(src, filename=filename)
     root = tree
@@ -87,8 +99,11 @@ def _violations_in_scope(src, filename, scope):
     for node in ast.walk(root):
         if isinstance(node, ast.Call):
             name = _call_name(node)
-            if name in GATHER_NAMES and not sanctioned(node.lineno):
+            if name in GATHER_NAMES and not sanctioned(node.lineno, PRAGMA):
                 yield (node.lineno, f"{name}() gather primitive")
+            if (name in TRANSFER_NAMES
+                    and not sanctioned(node.lineno, TRANSFER_PRAGMA)):
+                yield (node.lineno, f"{name}() host-to-device transfer")
 
 
 def check_files(scopes=None):

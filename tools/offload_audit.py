@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Offline beyond-HBM offload auditor.
+
+Reads a telemetry JSONL file from a training run with offload enabled
+(``zero_optimization.offload_param`` / ``offload_optimizer``) and folds
+the per-step ``offload_staged`` deltas (``runtime/engine.py``
+``_emit_offload_telemetry``) into a staging report: bytes written/read
+per store, prefetch-ring hit rate, and the blocking stall the offload
+engine imposed per optimizer step.  The companion of
+``tools/comm_audit.py``: shell-side forensics over artifacts a run left
+behind, no jax required.
+
+Usage::
+
+    python tools/offload_audit.py TELEMETRY_JSONL [--max-stall-frac X]
+                                  [--min-hit-rate Y] [--json OUT]
+
+Stall fraction is ``sum(wait_ms) / sum(step_time_ms)`` over the steps
+that have BOTH an ``offload_staged`` and a ``step`` record — the share
+of wall-clock the run spent blocked on staged I/O instead of compute.
+A healthy prefetch ring keeps it near zero (reads land before they are
+needed and count as ring hits); a rising stall fraction means the ring
+depth or the staging thread pool is undersized for the layer window.
+
+Prints a JSON report (also written to ``--json`` if given) and exits 0
+when the gates clear (``--max-stall-frac`` default 1.0 = always,
+``--min-hit-rate`` default 0), 1 when one does not, 2 on usage errors
+(unreadable file, no offload_staged records).
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_records(path: str):
+    """→ (offload_staged records, step_time_ms by step, error or None)."""
+    if not os.path.isfile(path):
+        return None, None, f"{path}: not a file"
+    staged, step_ms = [], {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue     # torn tail line from a crashed run
+                if not isinstance(rec, dict):
+                    continue
+                kind = rec.get("kind")
+                if kind == "offload_staged":
+                    staged.append(rec)
+                elif kind == "step" and "step_time_ms" in rec:
+                    step_ms[int(rec.get("step", -1))] = float(rec["step_time_ms"])
+    except OSError as e:
+        return None, None, f"unreadable {path}: {e}"
+    if not staged:
+        return None, None, (f"{path}: no offload_staged records (was the run "
+                            "started with offload_param/offload_optimizer?)")
+    return staged, step_ms, None
+
+
+def audit(staged, step_ms):
+    """Fold the per-step deltas into the audit report."""
+    comps = {}
+    wait_ms = 0.0
+    matched_wait = matched_step = 0.0
+    hits = misses = 0
+    for rec in staged:
+        wait_ms += float(rec.get("wait_ms", 0.0))
+        hits += int(rec.get("ring_hits", 0))
+        misses += int(rec.get("ring_misses", 0))
+        step = int(rec.get("step", -1))
+        if step in step_ms:
+            matched_wait += float(rec.get("wait_ms", 0.0))
+            matched_step += step_ms[step]
+        for key, val in rec.items():
+            for suffix in ("_bytes_written", "_bytes_read",
+                           "_ring_hits", "_ring_misses", "_wait_ms"):
+                if key.endswith(suffix):
+                    name = key[:-len(suffix)]
+                    comps.setdefault(name, {})
+                    field = suffix[1:]
+                    comps[name][field] = comps[name].get(field, 0) + val
+    for name, entry in comps.items():
+        h = int(entry.get("ring_hits", 0))
+        m = int(entry.get("ring_misses", 0))
+        entry["hit_rate"] = round(h / (h + m), 4) if (h + m) else 1.0
+        entry["wait_ms"] = round(float(entry.get("wait_ms", 0.0)), 3)
+    total = hits + misses
+    return {
+        "steps_audited": len(staged),
+        "steps_matched": sum(1 for r in staged
+                             if int(r.get("step", -1)) in step_ms),
+        "stores": comps,
+        "bytes_written": sum(int(e.get("bytes_written", 0))
+                             for e in comps.values()),
+        "bytes_read": sum(int(e.get("bytes_read", 0)) for e in comps.values()),
+        "ring_hits": hits,
+        "ring_misses": misses,
+        "hit_rate": round(hits / total, 4) if total else 1.0,
+        "wait_ms": round(wait_ms, 3),
+        "stall_frac": (round(matched_wait / matched_step, 4)
+                       if matched_step > 0 else 0.0),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Audit offload staging traffic from telemetry JSONL")
+    ap.add_argument("path", help="telemetry JSONL file")
+    ap.add_argument("--max-stall-frac", type=float, default=1.0,
+                    help="fail (exit 1) if wait/step-time exceeds this")
+    ap.add_argument("--min-hit-rate", type=float, default=0.0,
+                    help="fail (exit 1) if the ring hit rate is below this")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    staged, step_ms, err = load_records(args.path)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    report = {
+        "path": args.path,
+        "max_stall_frac": args.max_stall_frac,
+        "min_hit_rate": args.min_hit_rate,
+        **audit(staged, step_ms),
+    }
+    report["ok"] = (report["stall_frac"] <= args.max_stall_frac
+                    and report["hit_rate"] >= args.min_hit_rate)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
