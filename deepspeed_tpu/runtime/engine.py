@@ -684,12 +684,17 @@ class DeepSpeedEngine:
         folder = os.path.join(oc.nvme_path or "/tmp/dst_nvme", "optimizer")
         aio_cfg = get_aio_config(self._config._param_dict
                                  if hasattr(self._config, "_param_dict") else {})
-        # max_in_cpu=0: the optimizer tier is the truly dematerialized one —
-        # host copies drop the moment the NVMe write is durable.  The engine
-        # opts into pipelined (async) writeback; swap_in joins any pending
-        # write for a key before reading it back.
+        # max_in_cpu defaults to 0: the optimizer tier is the truly
+        # dematerialized one — host copies drop the moment the NVMe write
+        # is durable.  pipeline_write/buffer_count come straight from the
+        # user's offload_optimizer block; with pipeline_write the writeback
+        # drains asynchronously and swap_in joins any pending write for a
+        # key before reading it back.
         self.optimizer_swapper = PartitionedOptimizerSwapper(
-            folder, aio_cfg, max_in_cpu=0, pipeline_write=True)
+            folder, aio_cfg,
+            max_in_cpu=int(getattr(oc, "max_in_cpu", 0) or 0),
+            pipeline_write=bool(getattr(oc, "pipeline_write", False)),
+            buffer_count=max(2, int(getattr(oc, "buffer_count", 4) or 4)))
         self.optimizer_swapper.swap_out(self.state.opt_state)
         self.optimizer_swapper.drain()
         self.state.opt_state = None      # device/host copies released
@@ -2418,11 +2423,22 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         with self._span("train_batch", step=self.global_steps,
                         gas=self.gradient_accumulation_steps()):
-            carry = (self.state.params, self.state.opt_state, self.state.scaler,
-                     self.state.skipped)
+            # _opt_state_view materializes NVMe-swapped optimizer state
+            # (the fused path must mirror step()'s swap-in/swap-out — on a
+            # single device the layered micro path is inactive and fused is
+            # the only route offloaded training takes)
+            carry = (self.state.params, self._opt_state_view(),
+                     self.state.scaler, self.state.skipped)
             carry, loss, stats = self._fused_step(carry, batch, self._next_rng())
             (self.state.params, self.state.opt_state, self.state.scaler,
              self.state.skipped) = carry
+            if self.optimizer_swapper is not None:
+                self.optimizer_swapper.swap_out(self.state.opt_state)
+                self.state.opt_state = None
+            if self.param_swapper is not None:
+                self.param_swapper.swap_out_tree(self.state.params,
+                                                 prefix="param", sync=False)
+            self._emit_offload_telemetry()
         self._step_stats = stats
         self._cached_loss = loss
         self.micro_steps += self.gradient_accumulation_steps()

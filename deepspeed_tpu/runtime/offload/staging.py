@@ -15,7 +15,15 @@ process:
   number of chunk copies in flight is capped — the backpressure that keeps
   a fast producer from ballooning host RAM;
 * **capped in-flight depth** (``queue_depth``): submission blocks once that
-  many tasks are outstanding (the aio ``queue_depth`` semantic);
+  many tasks are outstanding (the aio ``queue_depth`` semantic) — the time
+  a submitter spends blocked on the cap is accounted to ``wait_s`` (and a
+  dedicated ``submit_wait_s``), so disk backpressure shows up in the audit
+  instead of hiding as trainer time;
+* **per-key write ordering**: ``write(key, ..., after=prev_future)`` makes
+  the worker wait for the previous in-flight write of the same key before
+  touching the file, so two overlapping writes of one key can never land
+  out of order (the stale-chunk race a multi-worker pool would otherwise
+  allow);
 * **CRC'd chunk files**: every chunk file's CRC-32 is computed while the
   bytes stream through the bounce buffer and recorded in a
   ``MANIFEST.json`` written with PR 3's atomic primitives
@@ -154,6 +162,7 @@ class StagingPool:
         self.read_count = 0
         self.wait_s = 0.0
         self.read_wait_s = 0.0
+        self.submit_wait_s = 0.0
         self._workers = [
             threading.Thread(target=self._worker, name=f"dst-staging-{i}",
                              daemon=True)
@@ -202,15 +211,35 @@ class StagingPool:
         return os.path.join(self.folder,
                             key.replace(os.sep, "_") + ".chunk")
 
-    def write(self, key: str, array) -> StagingFuture:
+    def _acquire_depth(self):
+        """Take a queue slot, accounting any blocking time: a saturated
+        queue stalling the submitter IS staged-I/O wait and must be
+        visible to the audit."""
+        if self._depth.acquire(blocking=False):
+            return
+        t0 = time.perf_counter()
+        self._depth.acquire()
+        waited = time.perf_counter() - t0
+        with self._lock:
+            self.wait_s += waited
+            self.submit_wait_s += waited
+
+    def write(self, key: str, array,
+              after: Optional[StagingFuture] = None) -> StagingFuture:
         """Enqueue an async write.  The device→host copy (for ``jax.Array``
         sources) happens in the worker thread; the caller may release its
-        reference immediately."""
+        reference immediately.  ``after`` (the previous in-flight write of
+        the same key) is awaited by the worker before the file is touched,
+        keeping same-key writes ordered across workers — ``after`` must be
+        a task enqueued earlier on this pool's FIFO queue, which the
+        per-key chaining in :class:`TieredStore` guarantees."""
         if self._closed:
             raise StagingError("staging pool is closed")
         fut = StagingFuture(self, key, "write")
-        self._depth.acquire()
-        self._queue.put(("write", key, array, fut))
+        if after is not None and after.done:
+            after = None
+        self._acquire_depth()
+        self._queue.put(("write", key, array, fut, after))
         return fut
 
     def read(self, key: str) -> StagingFuture:
@@ -219,8 +248,8 @@ class StagingPool:
         if self._closed:
             raise StagingError("staging pool is closed")
         fut = StagingFuture(self, key, "read")
-        self._depth.acquire()
-        self._queue.put(("read", key, None, fut))
+        self._acquire_depth()
+        self._queue.put(("read", key, None, fut, None))
         return fut
 
     def read_sync(self, key: str) -> np.ndarray:
@@ -244,9 +273,13 @@ class StagingPool:
             task = self._queue.get()
             if task is None:
                 return
-            op, key, array, fut = task
+            op, key, array, fut, after = task
             try:
                 if op == "write":
+                    if after is not None:
+                        # ordering barrier only — a failed predecessor must
+                        # not block the newer (superseding) write
+                        after._event.wait()
                     self._do_write(key, array)
                     fut._finish(None)
                 else:
@@ -340,7 +373,8 @@ class StagingPool:
                     "write_count": self.write_count,
                     "read_count": self.read_count,
                     "wait_s": self.wait_s,
-                    "read_wait_s": self.read_wait_s}
+                    "read_wait_s": self.read_wait_s,
+                    "submit_wait_s": self.submit_wait_s}
 
     def drain(self):
         """Join every enqueued task (writes durable, reads complete)."""

@@ -47,6 +47,10 @@ class TieredStore:
         self._pending_reads: Dict[str, StagingFuture] = {}
         self._pending_writes: Dict[str, StagingFuture] = {}
         self._lock = threading.RLock()
+        # serializes write SUBMISSION only (prev-lookup → enqueue → record),
+        # so same-key writes chain in order while the possibly-blocking
+        # enqueue (staging depth cap) never stalls get()/prefetch()/stats()
+        self._submit = threading.Lock()
         self.ring_hits = 0
         self.ring_misses = 0
 
@@ -54,12 +58,27 @@ class TieredStore:
     def put(self, key: str, array, write_through: bool = True):
         """Install a host copy and (by default) start the async NVMe
         write.  The host copy is what ``get`` serves while the write
-        drains, so the caller never waits here."""
+        drains, so the caller only waits here on staging backpressure
+        (the depth cap — accounted wait, taken outside the store lock).
+
+        Overlapping writes of one key are chained (``after=`` the previous
+        in-flight future) so a two-worker pool can never land the older
+        bytes last; an un-joined prefetch read issued before this put is
+        dropped, since its result would predate the new value."""
         host = np.asarray(array)
         with self._lock:
             self._host_insert(key, host)
-            if write_through:
-                self._pending_writes[key] = self.staging.write(key, host)
+            self._pending_reads.pop(key, None)
+            if not write_through:
+                self._evict_to_budget()
+                return
+        with self._submit:
+            with self._lock:
+                prev = self._pending_writes.get(key)
+            fut = self.staging.write(key, host, after=prev)
+            with self._lock:
+                self._pending_writes[key] = fut
+        with self._lock:
             self._evict_to_budget()
 
     def _host_insert(self, key: str, host: np.ndarray):
@@ -160,6 +179,24 @@ class TieredStore:
                 self._pending_writes.pop(key, None)
             self._evict_to_budget()
         self.staging.sync_manifest()
+
+    def remove(self, key: str):
+        """Drop every copy of one key — host cache, pending I/O, NVMe
+        chunk — so a later ``get``/``residency`` cannot serve a deleted
+        leaf from the LRU.  An in-flight write is joined first; otherwise
+        it would recreate the chunk file after the delete."""
+        with self._lock:
+            host = self._host.pop(key, None)
+            if host is not None:
+                self._host_bytes -= host.nbytes
+            self._pending_reads.pop(key, None)
+            wfut = self._pending_writes.pop(key, None)
+        if wfut is not None:
+            try:
+                wfut.result()
+            except StagingError:
+                pass
+        self.staging.delete(key)
 
     def invalidate(self):
         """Drop every cached/staged copy (rollback coherence): after a

@@ -31,13 +31,14 @@ class PartitionedOptimizerSwapper:
     PREFIX = "opt"
 
     def __init__(self, swap_folder: str, aio_config: Optional[Dict] = None,
-                 max_in_cpu: Optional[int] = None, pipeline_write: bool = False):
+                 max_in_cpu: Optional[int] = None, pipeline_write: bool = False,
+                 buffer_count: int = 2):
         # pipeline_write defaults off so ``swapped_bytes()`` is deterministic
         # right after ``swap_out`` (the engine opts into async writeback and
         # reads counters only at telemetry folds)
         self._swapper = AsyncPartitionedParameterSwapper(
-            swap_folder, aio_config, max_in_cpu=max_in_cpu,
-            chunk_paths=_blocks_chunking)
+            swap_folder, aio_config, buffer_count=buffer_count,
+            max_in_cpu=max_in_cpu, chunk_paths=_blocks_chunking)
         self._template = None       # shapes/dtypes pytree (host copy of state)
         self._pipeline_write = pipeline_write
 

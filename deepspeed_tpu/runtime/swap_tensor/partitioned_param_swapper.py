@@ -127,9 +127,12 @@ class AsyncPartitionedParameterSwapper:
         self._meta.clear()
 
     def remove(self, prefix: str = "p"):
+        """Delete every copy under ``prefix`` — NVMe chunks AND host-LRU /
+        pending entries, so a later ``get`` cannot resurrect a removed
+        leaf from the cache."""
         for key in list(self._meta):
             if key.startswith(prefix + "__"):
                 _, _, n_chunks = self._meta.pop(key)
                 for k in ([self._chunk_key(key, i) for i in range(n_chunks)]
                           if n_chunks else [key]):
-                    self.pool.delete(k)
+                    self.store.remove(k)

@@ -139,6 +139,33 @@ class TestZeroInfinityEngine:
         assert engine2.global_steps == 5
 
 
+class TestOffloadOptimizerConfigHonored:
+    def test_pipeline_write_and_buffer_count_flow_through(self, tmp_path):
+        """The engine must build the optimizer swapper from the user's
+        offload_optimizer block, not hardcoded values."""
+        from deepspeed_tpu.models.simple import SimpleModel
+
+        def mk(extra):
+            model = SimpleModel(hidden_dim=16)
+            oc = {"device": "nvme", "nvme_path": str(tmp_path)}
+            oc.update(extra)
+            engine, *_ = deepspeed_tpu.initialize(
+                model=model,
+                model_parameters=model.init_params(jax.random.key(0)),
+                config={"train_batch_size": 8,
+                        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                        "zero_optimization": {"stage": 1,
+                                              "offload_optimizer": oc}})
+            return engine.optimizer_swapper
+
+        sw = mk({"pipeline_write": True, "buffer_count": 3})
+        assert sw._pipeline_write is True
+        assert sw._swapper.pool._bounce.budget == \
+            3 * sw._swapper.pool._bounce.buffer_size
+        # config default: synchronous writeback
+        assert mk({})._pipeline_write is False
+
+
 class TestNvmeCheckpointResume:
     def test_load_checkpoint_with_nvme_offload(self, tmp_path):
         """Resuming a ZeRO-Infinity run: the restore target must come from

@@ -4,6 +4,8 @@ residency/eviction/ring accounting, the residency planner's refusal
 logic, and the per-block chunking of the pytree swappers built on top."""
 
 import os
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -71,6 +73,25 @@ class TestStagingPool:
         assert (tmp_path / "STAGING_MANIFEST.json").exists()
         pool.close()
 
+    def test_depth_backpressure_is_accounted(self, tmp_path, monkeypatch):
+        """A submitter blocked on the queue-depth cap is a staged-I/O
+        stall: it must show up in wait_s / submit_wait_s."""
+        orig = StagingPool._do_write
+
+        def slow(self, key, array):
+            time.sleep(0.2)
+            orig(self, key, array)
+
+        monkeypatch.setattr(StagingPool, "_do_write", slow)
+        pool = StagingPool(str(tmp_path), queue_depth=1, thread_count=1)
+        pool.write("a", np.zeros((8,), np.float32))
+        pool.write("b", np.zeros((8,), np.float32))  # blocks on the cap
+        pool.drain()
+        snap = pool.snapshot()
+        assert snap["submit_wait_s"] > 0
+        assert snap["wait_s"] >= snap["submit_wait_s"]
+        pool.close()
+
 
 class TestTieredStore:
     def test_host_hit_counts_as_ring_hit(self, tmp_path):
@@ -107,6 +128,77 @@ class TestTieredStore:
         store.invalidate()
         assert store.stats()["host_keys"] == 0
         assert not [p for p in os.listdir(tmp_path) if p.endswith(".chunk")]
+
+    def test_same_key_writes_land_in_order(self, tmp_path, monkeypatch):
+        """Two overlapping writes of one key on a multi-worker pool: the
+        older (artificially slow) write must not clobber the newer one on
+        disk — the per-key chaining race."""
+        orig = StagingPool._do_write
+
+        def slow_zeros(self, key, array):
+            if np.asarray(array).flat[0] == 0:   # only the first value
+                time.sleep(0.25)
+            orig(self, key, array)
+
+        monkeypatch.setattr(StagingPool, "_do_write", slow_zeros)
+        pool = StagingPool(str(tmp_path), thread_count=2)
+        store = TieredStore(pool, max_in_cpu=0)
+        store.put("k", np.zeros((8,), np.float32))
+        store.put("k", np.ones((8,), np.float32))
+        store.drain()
+        np.testing.assert_array_equal(pool.read_sync("k"),
+                                      np.ones((8,), np.float32))
+        pool.close()
+
+    def test_put_drops_stale_prefetch(self, tmp_path):
+        """A prefetch read issued before a put would serve pre-put bytes
+        if joined afterwards; put must drop it."""
+        store = TieredStore(StagingPool(str(tmp_path)), max_in_cpu=0)
+        store.put("k", np.zeros((8,), np.float32))
+        store.drain()
+        store.prefetch(["k"])
+        store.put("k", np.ones((8,), np.float32))
+        store.drain()                      # write durable -> host evicted
+        np.testing.assert_array_equal(store.get("k"),
+                                      np.ones((8,), np.float32))
+
+    def test_get_not_blocked_by_write_backpressure(self, tmp_path,
+                                                   monkeypatch):
+        """put() blocked on the staging depth cap must not hold the store
+        lock — concurrent get() of a host-resident key stays fast."""
+        orig = StagingPool._do_write
+
+        def slow(self, key, array):
+            if key.startswith("slow"):
+                time.sleep(0.5)
+            orig(self, key, array)
+
+        monkeypatch.setattr(StagingPool, "_do_write", slow)
+        pool = StagingPool(str(tmp_path), queue_depth=1, thread_count=1)
+        store = TieredStore(pool)
+        x = np.arange(4, dtype=np.float32)
+        store.put("x", x)
+
+        def saturate():
+            store.put("slow0", np.zeros((4,), np.float32))
+            store.put("slow1", np.zeros((4,), np.float32))  # blocks on cap
+
+        t = threading.Thread(target=saturate)
+        t.start()
+        time.sleep(0.1)                    # let the thread hit the cap
+        t0 = time.perf_counter()
+        np.testing.assert_array_equal(store.get("x"), x)
+        assert time.perf_counter() - t0 < 0.25
+        t.join()
+        pool.close()
+
+    def test_remove_drops_every_copy(self, tmp_path):
+        store = TieredStore(StagingPool(str(tmp_path)))
+        store.put("k", np.arange(8, dtype=np.float32))
+        store.remove("k")
+        assert store.residency("k") == ()
+        with pytest.raises(StagingError):
+            store.staging.read_sync("k")
 
 
 class TestResidencyPlanner:
@@ -182,3 +274,20 @@ class TestPerBlockChunking:
                          tree), prefix="param")
         for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
             np.testing.assert_array_equal(a, np.asarray(b))
+
+    def test_remove_evicts_host_cache_too(self, tmp_path):
+        """remove() must drop the store's host-LRU copies (and pending
+        entries), not just the NVMe chunks — otherwise a later get()
+        serves a removed leaf from the cache."""
+        from deepspeed_tpu.runtime.swap_tensor import (
+            AsyncPartitionedParameterSwapper)
+        sw = AsyncPartitionedParameterSwapper(
+            str(tmp_path), None, chunk_paths=lambda k: "blocks" in k.split("__"))
+        tree = {"blocks": {"w": np.ones((3, 4), np.float32)},
+                "emb": np.ones((4,), np.float32)}
+        sw.swap_out_tree(tree, prefix="param", sync=True)
+        assert sw.store.stats()["host_keys"] > 0
+        sw.remove(prefix="param")
+        assert sw.store.stats()["host_keys"] == 0
+        assert sw.pool.keys() == []
+        assert not [p for p in os.listdir(tmp_path) if p.endswith(".chunk")]
