@@ -849,7 +849,35 @@ def _degraded_artifact(err: str) -> bool:
     return True
 
 
+def _dslint_preflight():
+    """Static-analysis gate before any rung runs: a bench on a tree that
+    fails ``python -m tools.dslint`` measures a program the lints already
+    know is structurally wrong (host syncs in the step, lock-discipline
+    holes, a reverted overlap schedule).  Fails fast — exit 2 with the
+    machine report attached — instead of producing misleading numbers.
+    BENCH_SKIP_DSLINT=1 skips (e.g. to bisect a lint-dirty tree)."""
+    if os.environ.get("BENCH_SKIP_DSLINT"):
+        return
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dslint", "--json"],
+        cwd=here, capture_output=True, text=True, timeout=900)
+    if proc.returncode == 0:
+        return
+    try:
+        report = json.loads(proc.stdout)
+    except ValueError:
+        report = {"raw_stdout": proc.stdout[-2000:],
+                  "raw_stderr": proc.stderr[-2000:]}
+    print(json.dumps({"metric": "DSLINT PREFLIGHT FAILED",
+                      "returncode": proc.returncode,
+                      "report": report}))
+    sys.exit(2)
+
+
 def main():
+    _dslint_preflight()
     err = _probe_backend()
     if err is not None:
         if _degraded_artifact(err):

@@ -924,6 +924,7 @@ class DeepSpeedEngine:
                                                            compressed_allreduce)
         leaves = jax.tree.leaves(self.state.params)
         shapes = [p.shape for p in leaves]
+        # dslint: ok(zero-sync) — static python shape tuples, not traced values
         sizes = [int(np.prod(s)) for s in shapes]
         treedef = jax.tree.structure(self.state.params)
         b1 = self._onebit_comm["b1"]
@@ -1368,7 +1369,9 @@ class DeepSpeedEngine:
             blocks_def, [None if d is None else d - 1 for d in blocks_plan])
         pf = layered_mod.LayeredPrefetch(
             slice_plan, cc, self.compute_dtype, hpz=hpz, reuse=reuse,
-            depth=cc["prefetch_depth"], offload=bool(cc.get("offload")))
+            depth=cc["prefetch_depth"],
+            # dslint: ok(zero-sync) — host config flag, not a traced value
+            offload=bool(cc.get("offload")))
 
         baxes = mesh_lib.BATCH_AXES
         bspec = jax.tree.map(

@@ -121,10 +121,10 @@ class _BouncePool:
     def __init__(self, buffer_count: int, buffer_size: int):
         self.buffer_size = max(1, int(buffer_size))
         self.budget = max(1, int(buffer_count)) * self.buffer_size
-        self._avail = self.budget
         self._cond = threading.Condition()
+        self._avail = self.budget     # guarded-by: _cond
 
-    def acquire(self, nbytes: int) -> int:
+    def acquire(self, nbytes: int) -> int:  # may-block: waits for buffer space
         """Reserve ``min(nbytes, budget)`` bytes, blocking until free."""
         take = min(max(1, int(nbytes)), self.budget)
         with self._cond:
@@ -152,17 +152,17 @@ class StagingPool:
         self._bounce = _BouncePool(buffer_count, buffer_size)
         self._depth = threading.Semaphore(max(1, int(queue_depth)))
         self._queue: "queue.Queue[Optional[Tuple]]" = queue.Queue()
-        self._manifest: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
-        self._closed = False
+        self._manifest: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._closed = False                            # guarded-by: _lock
         # counters (read under _lock via snapshot())
-        self.bytes_written = 0
-        self.bytes_read = 0
-        self.write_count = 0
-        self.read_count = 0
-        self.wait_s = 0.0
-        self.read_wait_s = 0.0
-        self.submit_wait_s = 0.0
+        self.bytes_written = 0                          # guarded-by: _lock
+        self.bytes_read = 0                             # guarded-by: _lock
+        self.write_count = 0                            # guarded-by: _lock
+        self.read_count = 0                             # guarded-by: _lock
+        self.wait_s = 0.0                               # guarded-by: _lock
+        self.read_wait_s = 0.0                          # guarded-by: _lock
+        self.submit_wait_s = 0.0                        # guarded-by: _lock
         self._workers = [
             threading.Thread(target=self._worker, name=f"dst-staging-{i}",
                              daemon=True)
@@ -183,9 +183,11 @@ class StagingPool:
         except (OSError, ValueError):
             return
         if data.get("version") == MANIFEST_VERSION:
-            self._manifest.update(data.get("chunks", {}))
+            # workers are already running by now — publish under the lock
+            with self._lock:
+                self._manifest.update(data.get("chunks", {}))
 
-    def sync_manifest(self):
+    def sync_manifest(self):  # may-block: drain + fsync'd manifest write
         """Atomically persist the chunk manifest (PR 3 primitives: tmp +
         fsync + rename + dir fsync) — the durability point for everything
         written so far."""
@@ -224,7 +226,7 @@ class StagingPool:
             self.wait_s += waited
             self.submit_wait_s += waited
 
-    def write(self, key: str, array,
+    def write(self, key: str, array,  # may-block: depth-cap backpressure
               after: Optional[StagingFuture] = None) -> StagingFuture:
         """Enqueue an async write.  The device→host copy (for ``jax.Array``
         sources) happens in the worker thread; the caller may release its
@@ -233,8 +235,9 @@ class StagingPool:
         keeping same-key writes ordered across workers — ``after`` must be
         a task enqueued earlier on this pool's FIFO queue, which the
         per-key chaining in :class:`TieredStore` guarantees."""
-        if self._closed:
-            raise StagingError("staging pool is closed")
+        with self._lock:
+            if self._closed:
+                raise StagingError("staging pool is closed")
         fut = StagingFuture(self, key, "write")
         if after is not None and after.done:
             after = None
@@ -242,24 +245,25 @@ class StagingPool:
         self._queue.put(("write", key, array, fut, after))
         return fut
 
-    def read(self, key: str) -> StagingFuture:
+    def read(self, key: str) -> StagingFuture:  # may-block: depth-cap backpressure
         """Enqueue an async (prefetch) read; ``result()`` returns the
         reassembled ndarray, CRC-verified."""
-        if self._closed:
-            raise StagingError("staging pool is closed")
+        with self._lock:
+            if self._closed:
+                raise StagingError("staging pool is closed")
         fut = StagingFuture(self, key, "read")
         self._acquire_depth()
         self._queue.put(("read", key, None, fut, None))
         return fut
 
-    def read_sync(self, key: str) -> np.ndarray:
+    def read_sync(self, key: str) -> np.ndarray:  # may-block: synchronous file I/O
         """Synchronous read (a prefetch-ring MISS — counted as read wait)."""
         t0 = time.perf_counter()
         out = self._do_read(key)
         self._account_wait(time.perf_counter() - t0, "read")
         return out
 
-    def delete(self, key: str):
+    def delete(self, key: str):  # may-block: chunk-file unlink
         with self._lock:
             self._manifest.pop(key, None)
         try:
@@ -376,15 +380,18 @@ class StagingPool:
                     "read_wait_s": self.read_wait_s,
                     "submit_wait_s": self.submit_wait_s}
 
-    def drain(self):
+    def drain(self):  # may-block: joins every enqueued task
         """Join every enqueued task (writes durable, reads complete)."""
         self._queue.join()
 
-    def close(self):
-        if self._closed:
-            return
+    def close(self):  # may-block: drain + worker join
+        with self._lock:
+            if self._closed:
+                return
+            # set before the drain: a submitter racing close() must get the
+            # closed error, not enqueue behind the shutdown sentinels
+            self._closed = True
         self.drain()
-        self._closed = True
         for _ in self._workers:
             self._queue.put(None)
         for w in self._workers:

@@ -41,18 +41,18 @@ class TieredStore:
         # None = unbounded host cache; 0 = drop host copies as soon as the
         # NVMe write lands (every read then exercises the staged tier)
         self.max_in_cpu = None if max_in_cpu is None else int(max_in_cpu)
-        self._host: "OrderedDict[str, np.ndarray]" = OrderedDict()
-        self._host_bytes = 0
-        self._hbm: set = set()
-        self._pending_reads: Dict[str, StagingFuture] = {}
-        self._pending_writes: Dict[str, StagingFuture] = {}
+        self._host: "OrderedDict[str, np.ndarray]" = OrderedDict()  # guarded-by: _lock
+        self._host_bytes = 0                                        # guarded-by: _lock
+        self._hbm: set = set()                                      # guarded-by: _lock
+        self._pending_reads: Dict[str, StagingFuture] = {}          # guarded-by: _lock
+        self._pending_writes: Dict[str, StagingFuture] = {}         # guarded-by: _lock
         self._lock = threading.RLock()
         # serializes write SUBMISSION only (prev-lookup → enqueue → record),
         # so same-key writes chain in order while the possibly-blocking
         # enqueue (staging depth cap) never stalls get()/prefetch()/stats()
         self._submit = threading.Lock()
-        self.ring_hits = 0
-        self.ring_misses = 0
+        self.ring_hits = 0                                          # guarded-by: _lock
+        self.ring_misses = 0                                        # guarded-by: _lock
 
     # ---- write path ---------------------------------------------------- #
     def put(self, key: str, array, write_through: bool = True):
@@ -75,20 +75,24 @@ class TieredStore:
         with self._submit:
             with self._lock:
                 prev = self._pending_writes.get(key)
+            # _submit intentionally spans the possibly-blocking enqueue:
+            # same-key writes must chain in submission order, and _submit is
+            # touched by no other path, so readers never stall behind it
+            # dslint: ok(lock-discipline) — submission-order lock, see above
             fut = self.staging.write(key, host, after=prev)
             with self._lock:
                 self._pending_writes[key] = fut
         with self._lock:
             self._evict_to_budget()
 
-    def _host_insert(self, key: str, host: np.ndarray):
+    def _host_insert(self, key: str, host: np.ndarray):  # requires-lock: _lock
         old = self._host.pop(key, None)
         if old is not None:
             self._host_bytes -= old.nbytes
         self._host[key] = host
         self._host_bytes += host.nbytes
 
-    def _evict_to_budget(self):
+    def _evict_to_budget(self):  # requires-lock: _lock
         """LRU-drop host copies whose NVMe write has landed until the
         cache fits ``max_in_cpu``.  Copies without durable backing are
         never dropped — correctness beats the budget."""
@@ -108,14 +112,24 @@ class TieredStore:
 
     # ---- read path ----------------------------------------------------- #
     def prefetch(self, keys: Iterable[str]):
-        """Issue async NVMe reads for keys not already host-resident."""
+        """Issue async NVMe reads for keys not already host-resident.
+
+        Read submission can block on the staging depth cap, so it happens
+        OUTSIDE the store lock (the PR 10 backpressure shape: one saturated
+        queue must not stall every concurrent ``get``/``stats``).  The
+        re-check before recording each future drops reads made redundant —
+        or stale — by a ``put``/``get`` that landed the key meanwhile."""
         with self._lock:
-            for key in keys:
+            wanted = [key for key in keys
+                      if key not in self._host
+                      and key not in self._pending_reads
+                      and self.staging.chunk_info(key) is not None]
+        for key in wanted:
+            fut = self.staging.read(key)
+            with self._lock:
                 if key in self._host or key in self._pending_reads:
-                    continue
-                if self.staging.chunk_info(key) is None:
-                    continue
-                self._pending_reads[key] = self.staging.read(key)
+                    continue   # superseded while submitting; result unused
+                self._pending_reads[key] = fut
 
     def get(self, key: str) -> np.ndarray:
         """Return the host copy, joining a prefetch or falling back to a
@@ -144,6 +158,13 @@ class TieredStore:
                 self.ring_hits += 1
             else:
                 self.ring_misses += 1
+            cur = self._host.get(key)
+            if cur is not None:
+                # a concurrent put() installed a fresher copy while this
+                # thread was blocked on the NVMe read — the disk bytes are
+                # stale and must neither clobber the cache nor be returned
+                self._host.move_to_end(key)
+                return cur
             self._host_insert(key, host)
             self._evict_to_budget()
         return host
@@ -204,9 +225,12 @@ class TieredStore:
         restored state, so anything staged from the abandoned trajectory
         must not be readable."""
         self.drain()
+        # chunk deletion is file I/O — issued before (and outside) the lock;
+        # rollback runs with the trainer quiescent, so nothing re-stages
+        # between the deletes and the cache clear
+        for key in list(self.staging.keys()):
+            self.staging.delete(key)
         with self._lock:
-            for key in list(self.staging.keys()):
-                self.staging.delete(key)
             self._host.clear()
             self._host_bytes = 0
             self._pending_reads.clear()

@@ -258,9 +258,10 @@ class StabilitySentinel:
                 fingerprints: Sequence[str] = ()) -> Optional[Dict[str, Any]]:
         fps = [fp for fp in fingerprints if fp]
         if fps:
+            # dslint: ok(zero-sync) — host-side step counter, never traced
             self.ring.append({"step": int(step), "fps": fps})
         prev, self._pending = self._pending, {
-            "step": int(step),
+            "step": int(step),  # dslint: ok(zero-sync) — host step counter
             "code": stats.get("anomaly_code"),
             "loss": stats.get("loss"),
             "grad_norm": stats.get("grad_norm"),
@@ -269,6 +270,7 @@ class StabilitySentinel:
         }
         if prev is None:
             return None
+        # dslint: ok(zero-sync) — host-side step counter, never traced
         return self._judge(prev, detected_at=int(step))
 
     def drain(self) -> Optional[Dict[str, Any]]:

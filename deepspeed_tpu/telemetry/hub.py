@@ -228,6 +228,7 @@ class TelemetryHub:
         here blocks on the device."""
         if self.closed:
             return
+        # dslint: ok(zero-sync) — step is the host-side counter, never traced
         rec: Dict[str, Any] = {"step": int(step), "_t": time.time()}
         cbytes, cops = self._comm_totals()
         rec["_comm_bytes_cum"] = cbytes
@@ -244,6 +245,7 @@ class TelemetryHub:
             return
         rec = dict(payload)
         if step is not None:
+            # dslint: ok(zero-sync) — host-side step counter, never traced
             rec["step"] = int(step)
         self._pending.append(events.make_record(kind, rec))
 

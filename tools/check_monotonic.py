@@ -1,96 +1,28 @@
 #!/usr/bin/env python
 """Static clock-discipline check for the tracing/watchdog code paths.
 
-The hang watchdog and the tracer time *durations*; a wall clock
-(``time.time``) is wrong for that — NTP slews and admin clock-sets would
-fake or mask a stall.  This lint walks the AST of the timing-critical
-modules and fails on any wall-clock call:
+Thin shim: the check itself now lives in the unified static-analysis
+framework as the ``monotonic`` pass (``tools/dslint/monotonic.py``) and
+also runs from ``python -m tools.dslint``.  This entry point keeps the
+original CLI, exit codes, and ``check_files()`` surface for the suite
+(``tests/unit/telemetry/test_trace_merge.py``) and muscle memory.
 
-* ``time.time()`` / ``time.time_ns()``
-* ``datetime.now()`` / ``datetime.utcnow()`` / ``datetime.today()``
-* ``from time import time`` (aliased or not)
-
-One escape hatch: a line whose source carries the pragma string
-``wall-clock anchor`` is sanctioned — the tracer takes exactly one
-wall-clock reading so ``tools/trace_merge.py`` can align rank timelines,
-and that line is marked.
-
-Run directly (``python tools/check_monotonic.py``) or from the test
-suite (``tests/unit/telemetry/test_trace_merge.py``).  Exit 0 = clean.
+Flags wall-clock use (``time.time``/``time_ns``, ``datetime.now`` /
+``utcnow`` / ``today``, ``from time import time``) in the
+duration-measuring modules.  One escape hatch: a comment carrying
+``wall-clock anchor`` — the tracer takes exactly one wall reading so
+``tools/trace_merge.py`` can align rank timelines.  Exit 0 = clean.
 """
 
 import argparse
-import ast
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-PRAGMA = "wall-clock anchor"
-
-# the timing-critical surface: everything that measures durations for
-# spans, stalls, or dumps
-CHECKED_FILES = (
-    "deepspeed_tpu/telemetry/tracing.py",
-    "deepspeed_tpu/telemetry/watchdog.py",
-    "deepspeed_tpu/telemetry/flight_recorder.py",
-)
-
-_WALL_CLOCK_ATTRS = {
-    ("time", "time"),
-    ("time", "time_ns"),
-    ("datetime", "now"),
-    ("datetime", "utcnow"),
-    ("datetime", "today"),
-}
-
-
-def _violations_in_source(src: str, filename: str):
-    """Yield (lineno, message) for every unsanctioned wall-clock use."""
-    lines = src.splitlines()
-
-    def sanctioned(lineno: int) -> bool:
-        return 0 < lineno <= len(lines) and PRAGMA in lines[lineno - 1]
-
-    tree = ast.parse(src, filename=filename)
-    # names bound by `from time import time [as x]` / `from datetime ...`
-    wall_aliases = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module in ("time",
-                                                                "datetime"):
-            for alias in node.names:
-                if (node.module, alias.name) in _WALL_CLOCK_ATTRS or (
-                        node.module == "time"
-                        and alias.name in ("time", "time_ns")):
-                    if not sanctioned(node.lineno):
-                        yield (node.lineno,
-                               f"from {node.module} import {alias.name}")
-                    wall_aliases.add(alias.asname or alias.name)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
-            if (fn.value.id, fn.attr) in _WALL_CLOCK_ATTRS:
-                if not sanctioned(node.lineno):
-                    yield (node.lineno, f"{fn.value.id}.{fn.attr}()")
-        elif isinstance(fn, ast.Name) and fn.id in wall_aliases:
-            if not sanctioned(node.lineno):
-                yield (node.lineno, f"{fn.id}() (wall-clock import)")
-
-
-def check_files(paths=None):
-    """Return a list of 'file:line: message' violation strings."""
-    out = []
-    for rel in (paths or CHECKED_FILES):
-        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
-        with open(path) as f:
-            src = f.read()
-        for lineno, msg in _violations_in_source(src, path):
-            out.append(f"{rel}:{lineno}: {msg} — use time.monotonic_ns() "
-                       f"for durations (or mark a '{PRAGMA}' pragma)")
-    return out
+from tools.dslint.monotonic import (CHECKED_FILES, PASS_NAME, PRAGMA,  # noqa: E402,F401
+                                    check_files)
 
 
 def main(argv=None) -> int:

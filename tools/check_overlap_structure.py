@@ -1,124 +1,30 @@
 #!/usr/bin/env python
 """Static structure check for the layered ZeRO-3 step.
 
-The whole point of the layered stage-3 step is that stacked per-block
-parameters are gathered ONE SLICE AT A TIME inside the scan
-(``comm/compression/layered.py``), never as a whole-tree all-gather
-before the model runs — a whole-tree gather over a stacked block leaf
-silently reverts the step to the bulk schedule and the overlap
-disappears without any test failing (losses stay identical; only the
-timeline degrades).  This lint enforces the structure the schedule
-depends on:
+Thin shim: the check itself now lives in the unified static-analysis
+framework as the ``overlap`` pass (``tools/dslint/overlap.py``) and also
+runs from ``python -m tools.dslint``.  This entry point keeps the
+original CLI, exit codes, and ``check_files()`` surface for the suite
+(``tests/unit/comm/test_layered_overlap.py``) and muscle memory.
 
-* ``runtime/engine.py::_build_layered_step`` must contain NO direct
-  gather-primitive call (``lax.all_gather``, ``qwz.quantized_all_gather``,
-  ``hpz.hierarchical_gather`` / ``fast_regather`` /
-  ``slow_gather_secondary``).  Non-block ("rest") leaves are gathered
-  through the module-level ``_layered_rest_gather`` helper and block
-  leaves through ``layered.LayeredPrefetch`` — both outside this
-  function's body, so any gather call *inside* it is by construction a
-  whole-tree regression.
-* the scan-model files (``models/gpt.py``, ``models/bert.py``) must
-  contain no gather-primitive call at all: model code reaches parameters
-  only through the prefetch context (``zero_layered.current_prefetch``).
-* (PR 10) the same scopes must contain no host→device transfer call
-  (``device_put`` / ``_stage_to_device``): under offload the block
-  leaves live in host memory, and a whole-tree transfer before the scan
-  silently reverts the offload prefetch ring to a bulk upload the same
-  way a whole-tree gather reverts the overlap.  Per-slice staging lives
-  inside the ``custom_vjp`` impls in ``comm/compression/layered.py`` —
-  the one sanctioned site, outside every checked scope.
-
-Escape hatches: a line carrying the pragma string ``layered-gather ok``
-sanctions a gather; ``offload-transfer ok`` sanctions a transfer.
-
-Run directly (``python tools/check_overlap_structure.py``) or from the
-suite (``tests/unit/comm/test_layered_overlap.py``).  Exit 0 = clean.
+The layered stage-3 step gathers stacked per-block parameters ONE SLICE
+AT A TIME inside the scan; a whole-tree gather (or, under offload, a
+whole-tree host→device transfer) in ``_build_layered_step`` or the
+scan-model files silently reverts the step to the bulk schedule without
+any test failing.  Escape hatches: ``layered-gather ok`` /
+``offload-transfer ok`` comments.  Exit 0 = clean.
 """
 
 import argparse
-import ast
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-PRAGMA = "layered-gather ok"
-TRANSFER_PRAGMA = "offload-transfer ok"
-
-GATHER_NAMES = frozenset({
-    "all_gather", "all_gather_invariant", "quantized_all_gather",
-    "hierarchical_gather", "fast_regather", "slow_gather_secondary",
-})
-
-# Host→device transfer entry points: any of these on a whole (stacked)
-# block tree inside a checked scope defeats the offload prefetch ring.
-TRANSFER_NAMES = frozenset({"device_put", "_stage_to_device"})
-
-# (file, scope): scope None = whole file, else only the named function's body
-CHECKED_SCOPES = (
-    ("deepspeed_tpu/runtime/engine.py", "_build_layered_step"),
-    ("deepspeed_tpu/models/gpt.py", None),
-    ("deepspeed_tpu/models/bert.py", None),
-)
-
-
-def _call_name(node):
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return None
-
-
-def _find_function(tree, name):
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name == name:
-            return node
-    return None
-
-
-def _violations_in_scope(src, filename, scope):
-    lines = src.splitlines()
-
-    def sanctioned(lineno, pragma):
-        return 0 < lineno <= len(lines) and pragma in lines[lineno - 1]
-
-    tree = ast.parse(src, filename=filename)
-    root = tree
-    if scope is not None:
-        root = _find_function(tree, scope)
-        if root is None:
-            # the guarded function disappeared — that is itself a failure:
-            # the lint would otherwise pass vacuously forever
-            yield (1, f"guarded function {scope}() not found")
-            return
-    for node in ast.walk(root):
-        if isinstance(node, ast.Call):
-            name = _call_name(node)
-            if name in GATHER_NAMES and not sanctioned(node.lineno, PRAGMA):
-                yield (node.lineno, f"{name}() gather primitive")
-            if (name in TRANSFER_NAMES
-                    and not sanctioned(node.lineno, TRANSFER_PRAGMA)):
-                yield (node.lineno, f"{name}() host-to-device transfer")
-
-
-def check_files(scopes=None):
-    """Return a list of 'file:line: message' violation strings."""
-    out = []
-    for rel, scope in (scopes or CHECKED_SCOPES):
-        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
-        with open(path) as f:
-            src = f.read()
-        where = f"{rel}::{scope}" if scope else rel
-        for lineno, msg in _violations_in_scope(src, path, scope):
-            out.append(f"{rel}:{lineno}: {msg} in {where} — block leaves "
-                       "must go through layered.LayeredPrefetch (or mark a "
-                       f"'{PRAGMA}' pragma)")
-    return out
+from tools.dslint.overlap import (CHECKED_SCOPES, GATHER_NAMES,  # noqa: E402,F401
+                                  PASS_NAME, PRAGMA, TRANSFER_NAMES,
+                                  TRANSFER_PRAGMA, check_files)
 
 
 def main(argv=None) -> int:

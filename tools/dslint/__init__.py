@@ -1,0 +1,15 @@
+"""dslint — the repo's unified static-analysis subsystem.
+
+Run ``python -m tools.dslint [--json] [--only PASS[,PASS]]`` from the
+repo root.  See ``tools/dslint/core.py`` for the framework and the
+``README.md`` § *Static analysis* for the pass catalog and pragma
+grammar.
+"""
+
+from tools.dslint.core import (Context, Finding, LintPass, Pragma,
+                               ScanError, ScannedFile, all_passes,
+                               load_file, parse_pragmas, run_passes)
+
+__all__ = ["Context", "Finding", "LintPass", "Pragma", "ScanError",
+           "ScannedFile", "all_passes", "load_file", "parse_pragmas",
+           "run_passes"]

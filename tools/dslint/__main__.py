@@ -1,0 +1,86 @@
+"""CLI: ``python -m tools.dslint [--json] [--only PASS[,PASS]] [--list]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+
+The jaxpr pass needs 8 virtual CPU devices, and jax pins its device
+count at first import — so the environment is forced HERE, before any
+pass can import jax.  (If jax is somehow already imported with fewer
+devices, the jaxpr pass re-execs itself in a subprocess instead.)
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu_mesh_env():
+    if "jax" in sys.modules:
+        return   # too late — the jaxpr pass handles this case itself
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.dslint",
+        description="run the repo's static-analysis passes")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--only", default=None, metavar="PASS[,PASS]",
+                        help="run only the named pass(es)")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered passes and exit")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on bad usage already; normalize --help to 0
+        return int(e.code or 0)
+
+    _force_cpu_mesh_env()
+    from tools.dslint.core import ScanError, all_passes, run_passes
+
+    if args.list:
+        for p in all_passes():
+            print(f"{p.name:16s} {p.description}")
+        return 0
+
+    only = ([s.strip() for s in args.only.split(",") if s.strip()]
+            if args.only else None)
+    if args.only is not None and not only:
+        print("error: --only given with no pass names", file=sys.stderr)
+        return 2
+
+    try:
+        findings, ctx = run_passes(only=only)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    except ScanError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "clean": not findings,
+            "passes_run": ctx.ran,
+            "num_findings": len(findings),
+            "findings": [f.to_json() for f in findings],
+            "meta": ctx.meta,
+        }, indent=2, default=str))
+    else:
+        for f in findings:
+            print(f.format())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        print(f"dslint: {len(findings)} finding(s) "
+              f"({n_err} error, {n_warn} warning) "
+              f"from passes: {', '.join(ctx.ran)}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

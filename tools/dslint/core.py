@@ -1,0 +1,303 @@
+"""dslint core — the shared machinery every pass rides on.
+
+One scanner, one pragma engine, one findings model.  A pass is a class
+with a ``name``, a ``description`` and a ``run(ctx)`` returning
+:class:`Finding`s; the runner deduplicates file loading, resolves
+pragmas, and tracks which pragmas actually suppressed something so the
+stale-pragma pass can flag escape hatches that rotted.
+
+Pragma grammar (all forms must sit in a real ``#`` comment — pragma text
+inside a docstring or string literal sanctions nothing):
+
+* ``# dslint: ok(<pass>[, <pass>...]) — <reason>`` — suppress findings
+  from the named pass(es) on this line.  The reason is mandatory: an
+  escape hatch without a written justification is itself a finding.
+* legacy spellings kept from the pre-framework lints:
+  ``wall-clock anchor`` → ``ok(monotonic)``,
+  ``layered-gather ok`` / ``offload-transfer ok`` → ``ok(overlap)``.
+* ``# guarded-by: <lock>`` / ``# requires-lock: <lock>`` /
+  ``# may-block: <reason>`` — lock-discipline attribute annotations
+  (see :mod:`tools.dslint.lock_discipline`).
+
+Exit-code contract (enforced by ``__main__``): 0 clean, 1 findings,
+2 usage error.
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# --------------------------------------------------------------------------- #
+# findings
+# --------------------------------------------------------------------------- #
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+@dataclass
+class Finding:
+    """One diagnostic: where, which pass, what, and how to fix it."""
+    pass_name: str
+    file: str                 # repo-relative path (or jaxpr://<program>)
+    line: int
+    message: str
+    hint: str = ""
+    severity: str = SEV_ERROR
+
+    def format(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        out = f"{loc}: [{self.pass_name}] {self.message}"
+        if self.hint:
+            out += f" — {self.hint}"
+        return out
+
+    def to_json(self) -> Dict:
+        return {"pass": self.pass_name, "file": self.file, "line": self.line,
+                "message": self.message, "hint": self.hint,
+                "severity": self.severity}
+
+
+# --------------------------------------------------------------------------- #
+# pragma engine
+# --------------------------------------------------------------------------- #
+
+_OK_RE = re.compile(r"dslint:\s*ok\(\s*([^)]*?)\s*\)\s*(?:[—:-]+\s*(\S.*))?")
+
+#: pre-framework pragma spellings → the pass they sanction.  These carry
+#: their reason in surrounding prose, so no reason requirement applies.
+LEGACY_PRAGMAS = {
+    "wall-clock anchor": "monotonic",
+    "layered-gather ok": "overlap",
+    "offload-transfer ok": "overlap",
+}
+
+
+@dataclass
+class Pragma:
+    line: int
+    passes: Tuple[str, ...]
+    reason: str
+    raw: str
+    legacy: bool = False
+    #: comment is the whole line — it then also sanctions the NEXT line
+    #: (for calls too long to carry a trailing pragma)
+    own_line: bool = False
+    used_by: Set[str] = field(default_factory=set)
+
+
+def _iter_comments(src: str):
+    """(lineno, comment_text) for every real ``#`` comment token."""
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+def parse_pragmas(src: str) -> Dict[int, Pragma]:
+    """Pragma index for one source file, keyed by line number."""
+    lines = src.splitlines()
+
+    def _own(lineno: int) -> bool:
+        return (0 < lineno <= len(lines)
+                and lines[lineno - 1].lstrip().startswith("#"))
+
+    out: Dict[int, Pragma] = {}
+    for lineno, text in _iter_comments(src):
+        m = _OK_RE.search(text)
+        if m:
+            names = tuple(p.strip() for p in m.group(1).split(",") if p.strip())
+            out[lineno] = Pragma(line=lineno, passes=names,
+                                 reason=(m.group(2) or "").strip(), raw=text,
+                                 own_line=_own(lineno))
+            continue
+        for legacy, pass_name in LEGACY_PRAGMAS.items():
+            if legacy in text:
+                out[lineno] = Pragma(line=lineno, passes=(pass_name,),
+                                     reason=text.strip("# "), raw=text,
+                                     legacy=True, own_line=_own(lineno))
+                break
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# source scanner
+# --------------------------------------------------------------------------- #
+
+class ScanError(RuntimeError):
+    """A checked file is missing or unparseable — a hard error, never a
+    silent skip (a lint that skips its subject passes vacuously forever)."""
+
+
+class ScannedFile:
+    """One parsed source file: text, lines, AST, pragma index."""
+
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.lines = src.splitlines()
+        try:
+            self.tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            raise ScanError(f"{rel}: unparseable: {e}") from e
+        self.pragmas = parse_pragmas(src)
+
+    def find_function(self, name: str) -> Optional[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == name:
+                return node
+        return None
+
+    def comment_on(self, lineno: int) -> str:
+        """The raw source line (annotation checks look at trailing text)."""
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def load_file(path: str, repo_root: str = REPO_ROOT) -> ScannedFile:
+    abspath = path if os.path.isabs(path) else os.path.join(repo_root, path)
+    try:
+        with open(abspath) as f:
+            src = f.read()
+    except OSError as e:
+        raise ScanError(f"cannot read checked file {path}: {e}") from e
+    rel = os.path.relpath(abspath, repo_root)
+    if rel.startswith(".."):
+        rel = abspath
+    return ScannedFile(abspath, rel, src)
+
+
+# --------------------------------------------------------------------------- #
+# run context
+# --------------------------------------------------------------------------- #
+
+class Context:
+    """Shared state for one lint run: the file cache, pragma bookkeeping,
+    and the per-pass scan index the stale-pragma pass consumes."""
+
+    def __init__(self, repo_root: str = REPO_ROOT):
+        self.repo_root = repo_root
+        self._files: Dict[str, ScannedFile] = {}
+        # pass name -> set of rels it scanned
+        self.scanned_by: Dict[str, Set[str]] = {}
+        self.ran: List[str] = []
+        self.meta: Dict[str, object] = {}
+
+    def scan(self, path: str, for_pass: Optional[str] = None) -> ScannedFile:
+        key = path if os.path.isabs(path) else os.path.join(
+            self.repo_root, path)
+        sf = self._files.get(key)
+        if sf is None:
+            sf = load_file(path, self.repo_root)
+            self._files[key] = sf
+        if for_pass:
+            self.scanned_by.setdefault(for_pass, set()).add(sf.rel)
+        return sf
+
+    def files(self) -> Iterable[ScannedFile]:
+        return self._files.values()
+
+    def sanctioned(self, sf: ScannedFile, lineno: int, pass_name: str) -> bool:
+        """True when the line (or an own-line pragma comment directly
+        above it) carries a pragma naming ``pass_name``; marks the pragma
+        as live (consumed) for stale detection."""
+        for pragma in (sf.pragmas.get(lineno), sf.pragmas.get(lineno - 1)):
+            if pragma is None or pass_name not in pragma.passes:
+                continue
+            if pragma.line == lineno or pragma.own_line:
+                pragma.used_by.add(pass_name)
+                return True
+        return False
+
+
+class LintPass:
+    """Base class: subclasses set ``name``/``description`` and implement
+    ``run(ctx) -> list[Finding]``."""
+
+    name = "base"
+    description = ""
+
+    def run(self, ctx: Context) -> List[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------- #
+# AST helpers shared by the source passes
+# --------------------------------------------------------------------------- #
+
+def call_name(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# registry + runner
+# --------------------------------------------------------------------------- #
+
+def all_passes() -> List[LintPass]:
+    """The registered pass set, in execution order.  Imported lazily so
+    the cheap passes never pay for the jaxpr pass's jax import."""
+    from tools.dslint import (jaxpr_checks, lock_discipline, monotonic,
+                              overlap, stale_pragma, zero_sync)
+    return [
+        zero_sync.ZeroSyncPass(),
+        lock_discipline.LockDisciplinePass(),
+        monotonic.MonotonicPass(),
+        overlap.OverlapPass(),
+        jaxpr_checks.JaxprPass(),
+        stale_pragma.StalePragmaPass(),
+    ]
+
+
+def run_passes(only: Optional[Iterable[str]] = None,
+               repo_root: str = REPO_ROOT,
+               ctx: Optional[Context] = None):
+    """Run the (filtered) pass set → (findings, ctx).
+
+    Raises :class:`KeyError` for an unknown pass name in ``only`` — the
+    CLI maps that to exit code 2 (usage error).
+    """
+    passes = all_passes()
+    known = {p.name for p in passes}
+    if only is not None:
+        wanted = list(only)
+        unknown = [n for n in wanted if n not in known]
+        if unknown:
+            raise KeyError(f"unknown pass(es): {', '.join(unknown)} "
+                           f"(known: {', '.join(sorted(known))})")
+        passes = [p for p in passes if p.name in wanted]
+    ctx = ctx or Context(repo_root=repo_root)
+    findings: List[Finding] = []
+    for p in passes:
+        ctx.ran.append(p.name)
+        findings.extend(p.run(ctx))
+    return findings, ctx

@@ -1,0 +1,319 @@
+"""lock-discipline pass — guarded-by / blocking-under-lock checks for the
+threaded offload layers.
+
+The PR 10 review cycle was four concurrency races in
+``runtime/offload/``: a stale-chunk write ordering race, disk
+backpressure serialized under the store lock, an eviction that dropped
+un-persisted copies, and a rollback that could read stale bytes.  All
+four share two shapes this pass detects statically:
+
+1. **Unguarded field access** — a field annotated ``# guarded-by: <lock>``
+   at its ``__init__`` assignment is touched outside a ``with
+   self.<lock>:`` block.  Helper methods that run with the lock already
+   held declare it with ``# requires-lock: <lock>`` on the ``def`` line;
+   the checker then (a) assumes the lock inside the body and (b) flags
+   any call site that invokes the helper without holding it.
+
+2. **Lock held across a blocking call** — ``.result()``, ``.wait()``,
+   ``.join()``, ``.acquire()``, ``open()``, ``os.fsync/replace/...``,
+   ``time.sleep`` issued lexically inside a with-lock block.  A worker
+   needing that lock then deadlocks against the waiter, or (the PR 10
+   shape) every reader stalls behind one writer's disk latency.  Methods
+   that may block on I/O or a future are declared ``# may-block:
+   <reason>`` on their ``def`` line; calls to them count as blocking
+   too.  The condition-variable idiom (``self._cond.wait()`` inside
+   ``with self._cond:``) is exempt — wait() releases the held lock.
+
+Scope: every ``.py`` under ``runtime/offload/`` and
+``runtime/swap_tensor/``.  Annotations are opt-in per field — classes
+with documented single-thread ownership (the trainer-thread swappers)
+simply carry no ``guarded-by`` annotations.
+
+Escape hatch: ``# dslint: ok(lock-discipline) — <reason>``.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.dslint.core import (Context, Finding, LintPass, ScannedFile,
+                               _iter_comments, dotted_name)
+
+PASS_NAME = "lock-discipline"
+
+CHECKED_DIRS: Sequence[str] = (
+    "deepspeed_tpu/runtime/offload",
+    "deepspeed_tpu/runtime/swap_tensor",
+)
+
+_GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_]\w*)")
+_REQUIRES_RE = re.compile(r"requires-lock:\s*([A-Za-z_]\w*)")
+_MAYBLOCK_RE = re.compile(r"may-block\b")
+
+#: constructors whose result is a mutual-exclusion lock attribute
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+#: attribute calls that block the calling thread
+_BLOCKING_ATTRS = {"result", "join", "wait", "acquire"}
+
+#: module functions that do file I/O (or sleep) — blocking under a lock
+_BLOCKING_DOTTED = {
+    "os.fsync", "os.replace", "os.remove", "os.rename", "os.makedirs",
+    "os.listdir", "time.sleep", "shutil.rmtree",
+}
+
+_HINT = ("take the lock only around the shared-state mutation and issue "
+         "the blocking call outside it, or mark "
+         "'# dslint: ok(lock-discipline) - <reason>'")
+
+
+@dataclass
+class ClassModel:
+    name: str
+    locks: Set[str] = field(default_factory=set)
+    guarded: Dict[str, str] = field(default_factory=dict)   # field -> lock
+    requires: Dict[str, str] = field(default_factory=dict)  # method -> lock
+    may_block: Set[str] = field(default_factory=set)
+
+
+def _def_comment_lines(node: ast.AST) -> Iterator[int]:
+    """Line numbers where a def-level annotation may sit: the signature
+    lines, up to (not including) the first body statement."""
+    first_body = node.body[0].lineno if node.body else node.lineno + 1
+    for ln in range(node.lineno, max(node.lineno + 1, first_body)):
+        yield ln
+
+
+def _comments_by_line(sf: ScannedFile) -> Dict[int, str]:
+    return dict(_iter_comments(sf.src))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def build_class_models(sf: ScannedFile) -> List[Tuple[ast.ClassDef, ClassModel]]:
+    comments = _comments_by_line(sf)
+    out = []
+    for cls in [n for n in sf.tree.body if isinstance(n, ast.ClassDef)]:
+        model = ClassModel(cls.name)
+        for meth in [n for n in cls.body
+                     if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            for ln in _def_comment_lines(meth):
+                text = comments.get(ln, "")
+                m = _REQUIRES_RE.search(text)
+                if m:
+                    model.requires[meth.name] = m.group(1)
+                if _MAYBLOCK_RE.search(text):
+                    model.may_block.add(meth.name)
+            for node in ast.walk(meth):
+                # lock constructors: self.X = threading.Lock()/RLock()/...
+                if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                               ast.Call):
+                    ctor = dotted_name(node.value.func) or ""
+                    if ctor.split(".")[-1] in _LOCK_CTORS:
+                        for tgt in node.targets:
+                            attr = _self_attr(tgt)
+                            if attr:
+                                model.locks.add(attr)
+                # guarded-by annotations on self.F = ... lines
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    m = _GUARDED_RE.search(comments.get(node.lineno, ""))
+                    if m:
+                        model.guarded[attr] = m.group(1)
+        out.append((cls, model))
+    return out
+
+
+def _is_blocking_call(node: ast.Call, held: FrozenSet[str],
+                      may_block_names: Set[str]) -> Optional[str]:
+    """A human-readable description when this call can block, else None."""
+    fn = node.func
+    dn = dotted_name(fn)
+    if isinstance(fn, ast.Name) and fn.id == "open":
+        return "open() file I/O"
+    if dn in _BLOCKING_DOTTED:
+        return f"{dn}()"
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "wait":
+            # condition idiom: cond.wait() releases the held cond lock
+            recv = _self_attr(fn.value)
+            if recv is not None and recv in held:
+                return None
+            return f"{dn or fn.attr}() wait"
+        if fn.attr == "acquire":
+            # non-blocking probes (blocking=False) never stall
+            for kw in node.keywords:
+                if (kw.arg == "blocking"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False):
+                    return None
+            if any(isinstance(a, ast.Constant) and a.value is False
+                   for a in node.args):
+                return None
+            return f"{dn or 'acquire'}() lock/semaphore acquire"
+        if fn.attr in _BLOCKING_ATTRS:
+            return f"{dn or fn.attr}()"
+        if fn.attr in may_block_names:
+            return f"{dn or fn.attr}() (declared may-block)"
+    elif isinstance(fn, ast.Name) and fn.id in may_block_names:
+        return f"{fn.id}() (declared may-block)"
+    return None
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walks one method body tracking which locks are lexically held."""
+
+    def __init__(self, sf: ScannedFile, ctx: Context, model: ClassModel,
+                 method: ast.AST, may_block_names: Set[str],
+                 findings: List[Finding]):
+        self.sf = sf
+        self.ctx = ctx
+        self.model = model
+        self.method = method
+        self.may_block_names = may_block_names
+        self.findings = findings
+        req = model.requires.get(method.name)
+        self.held: FrozenSet[str] = frozenset([req] if req else [])
+
+    # -- helpers --------------------------------------------------------- #
+    def _report(self, lineno: int, message: str):
+        if self.ctx.sanctioned(self.sf, lineno, PASS_NAME):
+            return
+        self.findings.append(Finding(PASS_NAME, self.sf.rel, lineno,
+                                     message, hint=_HINT))
+
+    # -- lock scoping ---------------------------------------------------- #
+    def visit_With(self, node: ast.With):
+        taken = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in self.model.locks:
+                taken.append(attr)
+            if item.context_expr is not None:
+                self.visit(item.context_expr)
+        prev = self.held
+        self.held = self.held | frozenset(taken)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+
+    visit_AsyncWith = visit_With
+
+    def _visit_nested(self, node):
+        if node is self.method:        # the root def itself, not a closure
+            self.generic_visit(node)
+            return
+        # a nested def/lambda may run on another thread: locks held here
+        # do not transfer, and its body is checked lock-free
+        prev = self.held
+        self.held = frozenset()
+        self.generic_visit(node)
+        self.held = prev
+
+    visit_FunctionDef = _visit_nested
+    visit_AsyncFunctionDef = _visit_nested
+    visit_Lambda = _visit_nested
+
+    # -- the checks ------------------------------------------------------ #
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None and attr in self.model.guarded:
+            lock = self.model.guarded[attr]
+            if lock not in self.held:
+                self._report(
+                    node.lineno,
+                    f"{self.model.name}.{attr} (guarded-by {lock}) accessed "
+                    f"without holding {lock} in {self.method.name}()")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        callee = _self_attr(fn) if isinstance(fn, ast.Attribute) else None
+        if callee is not None and callee in self.model.requires:
+            lock = self.model.requires[callee]
+            if lock not in self.held:
+                self._report(
+                    node.lineno,
+                    f"call to {self.model.name}.{callee}() (requires-lock "
+                    f"{lock}) without holding {lock} in {self.method.name}()")
+        if self.held:
+            desc = _is_blocking_call(node, self.held, self.may_block_names)
+            if desc is not None:
+                locks = "+".join(sorted(self.held))
+                self._report(
+                    node.lineno,
+                    f"blocking call {desc} while holding {locks} in "
+                    f"{self.model.name}.{self.method.name}()")
+        self.generic_visit(node)
+
+
+def checked_files(repo_root: str) -> List[str]:
+    out = []
+    for d in CHECKED_DIRS:
+        full = os.path.join(repo_root, d)
+        if not os.path.isdir(full):
+            continue
+        for name in sorted(os.listdir(full)):
+            if name.endswith(".py"):
+                out.append(os.path.join(d, name))
+    return out
+
+
+def check_scanned_file(sf: ScannedFile, ctx: Context,
+                       may_block_names: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls, model in build_class_models(sf):
+        if not (model.guarded or model.requires):
+            continue   # un-annotated class: documented single-thread owner
+        # annotation sanity: a guard must name a real lock attribute
+        for fname, lock in sorted(model.guarded.items()):
+            if lock not in model.locks:
+                findings.append(Finding(
+                    PASS_NAME, sf.rel, cls.lineno,
+                    f"{model.name}.{fname} guarded-by {lock!r}, but "
+                    f"{lock!r} is not a Lock/RLock/Condition attribute "
+                    f"of {model.name}",
+                    hint="fix the annotation or construct the lock in "
+                         "__init__", severity="warning"))
+        for meth in [n for n in cls.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            if meth.name == "__init__":
+                continue   # construction precedes any concurrent access
+            _MethodChecker(sf, ctx, model, meth, may_block_names,
+                           findings).visit(meth)
+    return findings
+
+
+class LockDisciplinePass(LintPass):
+    name = PASS_NAME
+    description = ("guarded-by field annotations enforced at every access "
+                   "site; no blocking call while a lock is held "
+                   "(runtime/offload, runtime/swap_tensor)")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        rels = checked_files(ctx.repo_root)
+        scanned = [ctx.scan(rel, for_pass=self.name) for rel in rels]
+        # may-block registry is cross-file: the store calls into staging
+        may_block: Set[str] = set()
+        for sf in scanned:
+            for _, model in build_class_models(sf):
+                may_block |= model.may_block
+        out: List[Finding] = []
+        for sf in scanned:
+            out.extend(check_scanned_file(sf, ctx, may_block))
+        return out

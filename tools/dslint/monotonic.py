@@ -1,0 +1,104 @@
+"""monotonic pass — clock discipline for the tracing/watchdog code paths.
+
+Migrated from the standalone ``tools/check_monotonic.py`` (whose CLI
+survives as a shim over this module).  The hang watchdog and the tracer
+time *durations*; a wall clock (``time.time``) is wrong for that — NTP
+slews and admin clock-sets would fake or mask a stall.  Flags:
+
+* ``time.time()`` / ``time.time_ns()``
+* ``datetime.now()`` / ``datetime.utcnow()`` / ``datetime.today()``
+* ``from time import time`` (aliased or not)
+
+Escape hatches: the legacy ``wall-clock anchor`` pragma (the tracer's
+single sanctioned wall reading for cross-rank alignment) or
+``# dslint: ok(monotonic) — <reason>``.
+"""
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from tools.dslint.core import Context, Finding, LintPass, ScannedFile
+
+PASS_NAME = "monotonic"
+
+PRAGMA = "wall-clock anchor"
+
+#: the timing-critical surface: everything that measures durations for
+#: spans, stalls, or dumps
+CHECKED_FILES: Sequence[str] = (
+    "deepspeed_tpu/telemetry/tracing.py",
+    "deepspeed_tpu/telemetry/watchdog.py",
+    "deepspeed_tpu/telemetry/flight_recorder.py",
+)
+
+_WALL_CLOCK_ATTRS = {
+    ("time", "time"),
+    ("time", "time_ns"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+    ("datetime", "today"),
+}
+
+_HINT = ("use time.monotonic_ns() for durations (or mark a "
+         f"'{PRAGMA}' pragma)")
+
+
+def violations(sf: ScannedFile) -> Iterator[Tuple[int, str]]:
+    """(lineno, message) for every wall-clock use, pragma-blind — the
+    caller applies sanctioning so pragma usage is tracked centrally."""
+    tree = sf.tree
+    # names bound by `from time import time [as x]` / `from datetime ...`
+    wall_aliases = set()
+    imports = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in ("time",
+                                                                "datetime"):
+            for alias in node.names:
+                if (node.module, alias.name) in _WALL_CLOCK_ATTRS or (
+                        node.module == "time"
+                        and alias.name in ("time", "time_ns")):
+                    imports.append(
+                        (node.lineno,
+                         f"from {node.module} import {alias.name}"))
+                    wall_aliases.add(alias.asname or alias.name)
+    yield from imports
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            if (fn.value.id, fn.attr) in _WALL_CLOCK_ATTRS:
+                yield (node.lineno, f"{fn.value.id}.{fn.attr}()")
+        elif isinstance(fn, ast.Name) and fn.id in wall_aliases:
+            yield (node.lineno, f"{fn.id}() (wall-clock import)")
+
+
+def check_files(paths=None, ctx: Optional[Context] = None) -> List[str]:
+    """Shim-compatible surface: 'file:line: message' violation strings.
+    ``paths`` may point outside the repo (the unit tests lint tmp files)."""
+    ctx = ctx or Context()
+    out = []
+    for rel in (paths or CHECKED_FILES):
+        sf = ctx.scan(rel, for_pass=PASS_NAME)
+        for lineno, msg in violations(sf):
+            if ctx.sanctioned(sf, lineno, PASS_NAME):
+                continue
+            out.append(f"{rel}:{lineno}: {msg} — {_HINT}")
+    return out
+
+
+class MonotonicPass(LintPass):
+    name = PASS_NAME
+    description = ("no wall-clock (time.time/datetime.now) in the "
+                   "tracing/watchdog/flight-recorder duration paths")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in CHECKED_FILES:
+            sf = ctx.scan(rel, for_pass=self.name)
+            for lineno, msg in violations(sf):
+                if ctx.sanctioned(sf, lineno, self.name):
+                    continue
+                out.append(Finding(self.name, sf.rel, lineno,
+                                   f"wall-clock use: {msg}", hint=_HINT))
+        return out

@@ -1,0 +1,100 @@
+"""overlap pass — structural checks for the layered ZeRO-3 step.
+
+Migrated from the standalone ``tools/check_overlap_structure.py`` (whose
+CLI survives as a shim over this module).  The layered stage-3 step
+gathers stacked per-block parameters ONE SLICE AT A TIME inside the scan
+(``comm/compression/layered.py``); a whole-tree gather — or, under
+offload, a whole-tree host→device transfer — silently reverts the step
+to the bulk schedule without any test failing (losses stay identical;
+only the timeline degrades).  Checked structure:
+
+* ``runtime/engine.py::_build_layered_step`` contains no direct
+  gather-primitive call and no transfer entry point;
+* the scan-model files (``models/gpt.py``, ``models/bert.py``) contain
+  neither: model code reaches parameters only through the prefetch
+  context.
+
+Escape hatches: legacy ``layered-gather ok`` / ``offload-transfer ok``
+pragmas, or ``# dslint: ok(overlap) — <reason>``.
+"""
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from tools.dslint.core import (Context, Finding, LintPass, ScannedFile,
+                               call_name)
+
+PASS_NAME = "overlap"
+
+PRAGMA = "layered-gather ok"
+TRANSFER_PRAGMA = "offload-transfer ok"
+
+GATHER_NAMES = frozenset({
+    "all_gather", "all_gather_invariant", "quantized_all_gather",
+    "hierarchical_gather", "fast_regather", "slow_gather_secondary",
+})
+
+#: host→device transfer entry points: any of these on a whole (stacked)
+#: block tree inside a checked scope defeats the offload prefetch ring
+TRANSFER_NAMES = frozenset({"device_put", "_stage_to_device"})
+
+#: (file, scope): scope None = whole file, else only the named function
+CHECKED_SCOPES: Sequence[Tuple[str, Optional[str]]] = (
+    ("deepspeed_tpu/runtime/engine.py", "_build_layered_step"),
+    ("deepspeed_tpu/models/gpt.py", None),
+    ("deepspeed_tpu/models/bert.py", None),
+)
+
+_HINT = ("block leaves must go through layered.LayeredPrefetch (or mark a "
+         f"'{PRAGMA}' pragma)")
+
+
+def scope_violations(sf: ScannedFile,
+                     scope: Optional[str]) -> Iterator[Tuple[int, str]]:
+    root = sf.tree
+    if scope is not None:
+        root = sf.find_function(scope)
+        if root is None:
+            # the guarded function disappeared — that is itself a failure:
+            # the lint would otherwise pass vacuously forever
+            yield (1, f"guarded function {scope}() not found")
+            return
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in GATHER_NAMES:
+                yield (node.lineno, f"{name}() gather primitive")
+            if name in TRANSFER_NAMES:
+                yield (node.lineno, f"{name}() host-to-device transfer")
+
+
+def check_files(scopes=None, ctx: Optional[Context] = None) -> List[str]:
+    """Shim-compatible surface: 'file:line: message' violation strings."""
+    ctx = ctx or Context()
+    out = []
+    for rel, scope in (scopes or CHECKED_SCOPES):
+        sf = ctx.scan(rel, for_pass=PASS_NAME)
+        where = f"{rel}::{scope}" if scope else rel
+        for lineno, msg in scope_violations(sf, scope):
+            if ctx.sanctioned(sf, lineno, PASS_NAME):
+                continue
+            out.append(f"{rel}:{lineno}: {msg} in {where} — {_HINT}")
+    return out
+
+
+class OverlapPass(LintPass):
+    name = PASS_NAME
+    description = ("no whole-tree gathers or host-to-device transfers in "
+                   "the layered stage-3 step / scan-model scopes")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, scope in CHECKED_SCOPES:
+            sf = ctx.scan(rel, for_pass=self.name)
+            where = f"{rel}::{scope}" if scope else rel
+            for lineno, msg in scope_violations(sf, scope):
+                if ctx.sanctioned(sf, lineno, self.name):
+                    continue
+                out.append(Finding(self.name, sf.rel, lineno,
+                                   f"{msg} in {where}", hint=_HINT))
+        return out

@@ -1,0 +1,73 @@
+"""stale-pragma pass — escape hatches must not rot.
+
+A pragma sanctions exactly one thing: a finding some pass would
+otherwise raise on that line.  When the sanctioned call is later removed
+or rewritten, the pragma keeps sitting there, silently blessing whatever
+lands on that line next.  This pass flags, for every file another pass
+scanned this run:
+
+* a ``# dslint: ok(<pass>)`` (or legacy) pragma that no ran-pass
+  consumed — i.e. nothing on that line still matches the pass's
+  patterns;
+* a pragma naming a pass that does not exist (typo'd escape hatch);
+* a new-form pragma with no written reason (the reason is the review
+  contract — an unexplained escape hatch is indistinguishable from a
+  silenced bug).
+
+Only pragmas naming passes that actually ran over that file are judged,
+so ``--only`` runs never produce false staleness.
+"""
+
+from typing import List
+
+from tools.dslint.core import Context, Finding, LintPass
+
+PASS_NAME = "stale-pragma"
+
+
+class StalePragmaPass(LintPass):
+    name = PASS_NAME
+    description = ("flag dslint pragmas that no longer suppress anything, "
+                   "name unknown passes, or lack a reason")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        ran = set(ctx.ran)
+        known = ran | {self.name}
+        # passes register themselves lazily; resolve the full known set so
+        # a pragma for a pass excluded by --only is not "unknown"
+        try:
+            from tools.dslint.core import all_passes
+            known |= {p.name for p in all_passes()}
+        except Exception:
+            pass
+        for sf in ctx.files():
+            scanned_here = {p for p, rels in ctx.scanned_by.items()
+                            if sf.rel in rels}
+            for pragma in sf.pragmas.values():
+                unknown = [p for p in pragma.passes if p not in known]
+                if unknown:
+                    out.append(Finding(
+                        self.name, sf.rel, pragma.line,
+                        f"pragma names unknown pass(es) "
+                        f"{', '.join(unknown)}: {pragma.raw.strip()}",
+                        hint="fix the pass name — an unknown name "
+                             "sanctions nothing", severity="warning"))
+                if not pragma.legacy and not pragma.reason:
+                    out.append(Finding(
+                        self.name, sf.rel, pragma.line,
+                        f"pragma has no reason: {pragma.raw.strip()}",
+                        hint="write '# dslint: ok(<pass>) - <why this "
+                             "line is sanctioned>'", severity="warning"))
+                judged = [p for p in pragma.passes
+                          if p in ran and p in scanned_here]
+                stale = [p for p in judged if p not in pragma.used_by]
+                if judged and stale and not pragma.used_by:
+                    out.append(Finding(
+                        self.name, sf.rel, pragma.line,
+                        f"stale pragma: nothing on this line still "
+                        f"matches pass(es) {', '.join(stale)}",
+                        hint="the sanctioned call was removed or "
+                             "rewritten — delete the pragma",
+                        severity="warning"))
+        return out

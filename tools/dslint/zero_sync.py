@@ -1,0 +1,126 @@
+"""zero-sync pass — no host syncs inside the zero-sync contract scopes.
+
+The telemetry hub promises "telemetry-on never syncs the device per
+step"; the stability sentinel promises anomaly detection without
+blocking reads on the clean path; the engine's step builders trace pure
+programs where a host materialization is either a trace error or (worse)
+a silent per-step device drain.  PR 1 and PR 5 guarded this with a spy
+``read_fn`` test that only sees the calls the test happens to drive;
+this pass checks the property on every line of the contract scopes.
+
+Flagged patterns (all of which force or imply a device→host sync when
+applied to an in-flight ``jax.Array``):
+
+* ``.item()``
+* ``float(x)`` / ``int(x)`` / ``bool(x)`` on a non-constant argument
+* ``np.asarray(...)`` / ``np.array(...)`` (and the ``numpy.`` spellings)
+* ``jax.device_get(...)`` (and bare ``device_get``)
+* ``.block_until_ready()`` / ``jax.block_until_ready(...)``
+
+Escape hatch: ``# dslint: ok(zero-sync) — <reason>`` on the line, e.g.
+for ``int(step)`` on a host step counter.
+"""
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from tools.dslint.core import (Context, Finding, LintPass, ScannedFile,
+                               dotted_name)
+
+PASS_NAME = "zero-sync"
+
+#: (file, scope) — scope None checks the whole file, else only the named
+#: function's body.  These are the scopes whose docstrings promise the
+#: zero-sync contract.
+CHECKED_SCOPES: Sequence[Tuple[str, Optional[str]]] = (
+    # telemetry hot path: record_step/emit buffer in-flight device values;
+    # the single sanctioned drain lives in flush() (out of scope).
+    ("deepspeed_tpu/telemetry/hub.py", "record_step"),
+    ("deepspeed_tpu/telemetry/hub.py", "emit"),
+    ("deepspeed_tpu/telemetry/hub.py", "_comm_totals"),
+    # sentinel clean path: observe() buffers; the lagged read happens in
+    # _judge() through the injected read_fn (out of scope by design).
+    ("deepspeed_tpu/runtime/stability.py", "observe"),
+    ("deepspeed_tpu/runtime/stability.py", "sentinel_observe"),
+    # engine step builders: everything traced into a compiled program.
+    ("deepspeed_tpu/runtime/engine.py", "_build_grad_step_local"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_compress_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_cc_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_layered_secondary"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_layered_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_grad_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_eval_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_acc_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_apply_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_build_fused_step"),
+    ("deepspeed_tpu/runtime/engine.py", "_value_and_grad"),
+    ("deepspeed_tpu/runtime/engine.py", "_device_view"),
+)
+
+_NUMPY_MODULES = ("np", "numpy")
+_COERCIONS = ("float", "int", "bool")
+_HINT = ("the zero-sync contract forbids device->host materialization "
+         "here; move the read to the windowed drain / lagged-read path, "
+         "or mark '# dslint: ok(zero-sync) - <reason>'")
+
+
+def _violations(root: ast.AST) -> Iterator[Tuple[int, str]]:
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not node.args:
+                yield node.lineno, ".item() forces a host sync"
+                continue
+            if fn.attr == "block_until_ready":
+                yield node.lineno, "block_until_ready() blocks on the device"
+                continue
+            if fn.attr == "device_get":
+                yield node.lineno, f"{dotted_name(fn) or 'device_get'}() " \
+                                   "pulls values to the host"
+                continue
+            owner = dotted_name(fn.value)
+            if owner in _NUMPY_MODULES and fn.attr in ("asarray", "array"):
+                yield node.lineno, (f"{owner}.{fn.attr}() materializes a "
+                                    "host copy")
+                continue
+        elif isinstance(fn, ast.Name):
+            if fn.id == "device_get":
+                yield node.lineno, "device_get() pulls values to the host"
+                continue
+            if (fn.id in _COERCIONS and len(node.args) == 1
+                    and not isinstance(node.args[0], ast.Constant)):
+                yield node.lineno, (f"{fn.id}() on a possibly-traced value "
+                                    "forces a host sync")
+
+
+def scope_violations(sf: ScannedFile, scope: Optional[str]):
+    """(lineno, message) for every unsanctioned sync pattern in scope.
+    A named scope that no longer exists is itself a violation — the lint
+    must not pass vacuously after a rename."""
+    root = sf.tree
+    if scope is not None:
+        root = sf.find_function(scope)
+        if root is None:
+            yield 1, f"guarded function {scope}() not found"
+            return
+    yield from _violations(root)
+
+
+class ZeroSyncPass(LintPass):
+    name = PASS_NAME
+    description = ("no host syncs (.item/float/np.asarray/device_get/"
+                   "block_until_ready) inside the zero-sync contract scopes")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, scope in CHECKED_SCOPES:
+            sf = ctx.scan(rel, for_pass=self.name)
+            where = f"{rel}::{scope}" if scope else rel
+            for lineno, msg in scope_violations(sf, scope):
+                if ctx.sanctioned(sf, lineno, self.name):
+                    continue
+                out.append(Finding(self.name, sf.rel, lineno,
+                                   f"{msg} in {where}", hint=_HINT))
+        return out
