@@ -207,6 +207,12 @@ class StagingPool:
         with self._lock:
             return sorted(self._manifest)
 
+    def total_bytes(self) -> int:
+        """Bytes currently held by the chunk tier (manifest sum) — the
+        NVMe occupancy the serving spill budget is checked against."""
+        with self._lock:
+            return sum(int(info["bytes"]) for info in self._manifest.values())
+
     # ---- submission --------------------------------------------------- #
     def _path(self, key: str) -> str:
         # keys may carry path-like separators; flatten to one file name

@@ -85,6 +85,28 @@ class TieredStore:
         with self._lock:
             self._evict_to_budget()
 
+    def put_device(self, key: str, array):  # may-block: staging backpressure
+        """Device-buffer write path: hand ``array`` (typically a
+        ``jax.Array``) straight to the staging pool, whose worker performs
+        the device→host DMA (``np.asarray``) off the caller's thread.  No
+        host-LRU copy is installed — a spill larger than the host budget
+        must not wash the cache; ``get`` serves it from the chunk tier (or
+        from a prefetch that landed it back in the LRU)."""
+        with self._lock:
+            # the new bytes supersede any cached copy / in-flight read
+            old = self._host.pop(key, None)
+            if old is not None:
+                self._host_bytes -= old.nbytes
+            self._pending_reads.pop(key, None)
+        with self._submit:
+            with self._lock:
+                prev = self._pending_writes.get(key)
+            # same submission-order discipline as put() above
+            # dslint: ok(lock-discipline) — submission-order lock, see put()
+            fut = self.staging.write(key, array, after=prev)
+            with self._lock:
+                self._pending_writes[key] = fut
+
     def _host_insert(self, key: str, host: np.ndarray):  # requires-lock: _lock
         old = self._host.pop(key, None)
         if old is not None:
@@ -168,6 +190,18 @@ class TieredStore:
             self._host_insert(key, host)
             self._evict_to_budget()
         return host
+
+    def ready(self, key: str) -> bool:
+        """True when a ``get`` of ``key`` would not block: host-resident,
+        or an issued prefetch has completed.  Pure state inspection
+        (``StagingFuture.done`` is a non-blocking event check) — the
+        scheduler polls this to admit restaging sequences only once their
+        window is resident."""
+        with self._lock:
+            if key in self._host:
+                return True
+            fut = self._pending_reads.get(key)
+            return fut is not None and fut.done
 
     # ---- residency / coherence ----------------------------------------- #
     def mark_hbm(self, key: str, resident: bool = True):

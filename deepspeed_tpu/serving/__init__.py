@@ -2,10 +2,14 @@
 
 Layering (host control plane → device data plane):
 
-* :mod:`deepspeed_tpu.serving.kv_cache` — free-list block allocator +
-  per-sequence block tables over a preallocated device arena;
+* :mod:`deepspeed_tpu.serving.kv_cache` — refcounted free-list block
+  allocator + per-sequence block tables over a preallocated device arena;
 * :mod:`deepspeed_tpu.serving.scheduler` — admission, chunked prefill,
-  SLO-class preemption with eviction/recompute;
+  SLO-class preemption with the spill→evict reclamation ladder;
+* :mod:`deepspeed_tpu.serving.kv_tiering` — spill/restage of preempted KV
+  through the PR 10 host/NVMe offload store (recompute → restore);
+* :mod:`deepspeed_tpu.serving.prefix_cache` — refcounted trie sharing
+  full prompt blocks across requests (prefill-once system prompts);
 * :mod:`deepspeed_tpu.serving.engine` — the two-program (decode + prefill)
   jitted step and the ``submit()/step()/run()`` surface;
 * config: :class:`DeepSpeedServingConfig`, the ``"serving"`` ds_config key.
@@ -15,13 +19,17 @@ from deepspeed_tpu.serving.config import DeepSpeedServingConfig
 from deepspeed_tpu.serving.engine import ServeFuture, ServingEngine, init_serving
 from deepspeed_tpu.serving.kv_cache import (ArenaExhausted, PagedKVAllocator,
                                             arena_bytes, init_arena)
+from deepspeed_tpu.serving.kv_tiering import KVTieringManager
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.scheduler import (QueueFull, Request,
                                              ServingScheduler, SLO_PRIORITY)
 
 __all__ = [
     "ArenaExhausted",
     "DeepSpeedServingConfig",
+    "KVTieringManager",
     "PagedKVAllocator",
+    "PrefixCache",
     "QueueFull",
     "Request",
     "SLO_PRIORITY",

@@ -34,6 +34,16 @@ class DeepSpeedServingConfig(DeepSpeedConfigModel):
     slo_preemption: bool = True   # higher SLO classes may evict lower ones
     max_new_tokens_default: int = 64
     eos_token_id: Optional[int] = None
+    # ---- tiered KV (serving/kv_tiering.py) -------------------------------- #
+    kv_tiering: bool = False          # spill preempted KV to host/NVMe
+    kv_offload_dir: Optional[str] = None   # None -> private tempdir
+    kv_host_cache_bytes: int = 1 << 30     # host-LRU tier budget
+    kv_spill_budget_bytes: int = 0         # total spill cap; 0 = unbounded
+    kv_spill_chunk_blocks: int = 8         # copy-ring chunk (blocks)
+    kv_ring_depth: int = 2                 # outstanding D2H chunk gathers
+    # ---- prefix cache (serving/prefix_cache.py) --------------------------- #
+    prefix_cache: bool = False        # share full prompt blocks, refcounted
+    prefix_cache_blocks: int = 0      # pinned-block cap; 0 = unbounded
     # ---- numerics / misc ------------------------------------------------- #
     dtype: str = "bfloat16"
     seed: int = 0

@@ -32,9 +32,12 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.runtime.offload import StagingError
 from deepspeed_tpu.serving.config import DeepSpeedServingConfig
 from deepspeed_tpu.serving.kv_cache import (ArenaExhausted, PagedKVAllocator,
                                             init_arena)
+from deepspeed_tpu.serving.kv_tiering import KVTieringManager
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.scheduler import (DECODE, FINISHED, SLO_PRIORITY,
                                              Request, ServingScheduler)
 from deepspeed_tpu.telemetry.tracing import get_global_tracer
@@ -66,6 +69,66 @@ class ServeFuture:
             self._engine.step()
         raise TimeoutError(
             f"request {self.request.rid} unfinished after {max_steps} steps")
+
+
+class _TieringAdapter:
+    """Bridges the scheduler's request-level spill/restage hooks to the
+    :class:`KVTieringManager`'s rid/block-level API, and owns the
+    ``kv_spill``/``kv_restage`` telemetry.  Only blocks a sequence has
+    actually *written* (``blocks_for_tokens(prefilled)``) are spilled —
+    a growth block allocated for the next token holds garbage."""
+
+    def __init__(self, engine: "ServingEngine"):
+        self.engine = engine
+        self.mgr = engine.tiering
+
+    def spill(self, req: Request):
+        eng = self.engine
+        n = eng.alloc.blocks_for_tokens(req.prefilled)
+        blocks = eng.alloc.owned_blocks(req.rid)[:n]
+        tier = self.mgr.spill(req.rid, blocks, eng._k_pages, eng._v_pages,
+                              req.prefilled)
+        if tier is not None:
+            eng._emit("kv_spill", {
+                "rid": req.rid, "slo": req.slo, "tier": tier,
+                "blocks": len(blocks), "tokens": req.prefilled,
+                "bytes": self.mgr.chunk_bytes(eng._k_pages, len(blocks)),
+            }, step=eng.step_count)
+        return tier
+
+    def begin_restage(self, req: Request) -> None:
+        self.mgr.begin_restage(req.rid)
+
+    def restage_ready(self, req: Request) -> bool:
+        return self.mgr.restage_ready(req.rid)
+
+    def restage(self, req: Request) -> bool:
+        eng = self.engine
+        n = eng.alloc.blocks_for_tokens(req.spilled_tokens)
+        dest = eng.alloc.owned_blocks(req.rid)[:n]
+        try:
+            eng._k_pages, eng._v_pages, info = self.mgr.restage(
+                req.rid, eng._k_pages, eng._v_pages, dest)
+        except (KeyError, StagingError) as e:
+            # unreadable/missing chunk: drop the record and recompute —
+            # the destructive-evict contract still yields identical tokens
+            self.mgr.discard(req.rid)
+            eng._emit("kv_restage", {"rid": req.rid, "ok": False,
+                                     "error": str(e)}, step=eng.step_count)
+            return False
+        eng._emit("kv_restage", {
+            "rid": req.rid, "ok": True, "source": info["source"],
+            "ready": info["ready"], "wait_ms": info["wait_s"] * 1000.0,
+            "blocks": info["blocks"], "tokens": info["tokens"],
+            "bytes": info["bytes"],
+        }, step=eng.step_count)
+        return True
+
+    def discard(self, req: Request) -> None:
+        self.mgr.discard(req.rid)
+
+    def describe_tiers(self) -> str:
+        return self.mgr.describe()
 
 
 class ServingEngine:
@@ -114,6 +177,23 @@ class ServingEngine:
         self._k_pages, self._v_pages = init_arena(
             mcfg, cfg.num_blocks, cfg.block_size, dtype=self.dtype)
 
+        # ---- tiered spill/restage + prefix sharing (both opt-in) ---------- #
+        self.tiering: Optional[KVTieringManager] = None
+        self.prefix: Optional[PrefixCache] = None
+        if cfg.kv_tiering:
+            self.tiering = KVTieringManager(
+                offload_dir=cfg.kv_offload_dir,
+                host_cache_bytes=cfg.kv_host_cache_bytes,
+                spill_budget_bytes=cfg.kv_spill_budget_bytes,
+                spill_chunk_blocks=cfg.kv_spill_chunk_blocks,
+                ring_depth=cfg.kv_ring_depth)
+            self.sched.tiering = _TieringAdapter(self)
+        if cfg.prefix_cache:
+            self.prefix = PrefixCache(self.alloc,
+                                      max_blocks=cfg.prefix_cache_blocks)
+            self.sched.prefix_cache = self.prefix
+            self.sched.on_prefix_hit = self._on_prefix_hit
+
         # ---- the (single) jitted step ------------------------------------ #
         def step_fn(params, ids, positions, kp, vp, tables, wb, wo):
             logits, kp, vp = model.paged_step(params, ids, positions, kp, vp,
@@ -132,6 +212,8 @@ class ServingEngine:
         self._futures: Dict[int, ServeFuture] = {}
         self.step_count = 0
         self.tokens_generated = 0
+        self._started = time.monotonic()
+        self._closed = False
         log_dist(
             f"ServingEngine ready: slots={cfg.max_batch_size}, "
             f"arena={cfg.num_blocks}x{cfg.block_size} tok "
@@ -153,6 +235,14 @@ class ServingEngine:
             "rid": victim.rid, "slo": victim.slo,
             "generated": len(victim.generated),
             "preemptions": victim.preemptions,
+            "spilled": victim.spilled,
+        }, step=self.step_count)
+
+    def _on_prefix_hit(self, req: Request, blocks: List[int]):
+        self._emit("prefix_hit", {
+            "rid": req.rid, "slo": req.slo, "blocks": len(blocks),
+            "tokens": len(blocks) * self._config.block_size,
+            "prompt_tokens": len(req.prompt),
         }, step=self.step_count)
 
     def compiled_programs(self) -> int:
@@ -228,7 +318,12 @@ class ServingEngine:
         self.step_count += 1
         stats = dict(self.sched.stats(), decode_batch=len(decode),
                      prefill_tokens=prefill_tokens,
-                     tokens_generated=self.tokens_generated)
+                     tokens_generated=self.tokens_generated,
+                     elapsed_ms=(time.monotonic() - self._started) * 1000.0)
+        if self.tiering is not None:
+            stats.update(self.tiering.stats())
+        if self.prefix is not None:
+            stats.update(self.prefix.stats())
         if (self.telemetry is not None and self._config.telemetry_every
                 and self.step_count % self._config.telemetry_every == 0):
             self._emit("serve_step", stats, step=self.step_count)
@@ -243,6 +338,15 @@ class ServingEngine:
                 raise TimeoutError(f"serving drain exceeded {max_steps} steps")
             self.step()
         return self.step_count - start
+
+    def close(self):
+        """Release the tiering backend (staging threads + an owned
+        tempdir); idempotent, and a no-op without tiering."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.tiering is not None:
+            self.tiering.close()
 
     # ------------------------------------------------------------------ #
     def _run_prefill(self, req: Request, start: int, n: int):
@@ -261,6 +365,11 @@ class ServingEngine:
             jnp.asarray(wb[None]), jnp.asarray(wo[None]))
         req.prefilled += n
         if req.prefilled >= req.prefill_len:
+            if self.prefix is not None:
+                # the prompt's full blocks now hold valid KV: pin them for
+                # later requests sharing this prefix (idempotent re-insert)
+                self.prefix.insert(req.prompt,
+                                   self.alloc.owned_blocks(req.rid))
             # the chunk holding the last context token also yields the next
             # token — first-token latency includes no extra decode step
             req.state = DECODE

@@ -21,6 +21,17 @@ Scheduling policy (see README § Serving):
   Victim order is weakest SLO class first, then youngest admission, and
   never the requester — so the oldest admitted request always progresses
   and the eviction loop terminates.
+* **Tiering** (engine-installed, optional): reclamation is a ladder, least
+  destructive rung first — (1) release prefix-cache pins, (2) *spill* the
+  victim's KV to host/NVMe before its blocks are reclaimed (recompute
+  becomes restore), (3) destructive evict when the spill budget refuses.
+  A spilled request's restage is prefetched while it waits and it is
+  admitted only once its bytes are resident — unless the engine is
+  otherwise idle, when blocking on the restage beats doing nothing.
+  ``ArenaExhausted`` still means the requester alone cannot hold its
+  window in the *device* arena (host/NVMe cannot substitute for decode
+  residency); with tiering on, every other sequence has been spilled —
+  not destroyed — first, and the error reports tier occupancy.
 """
 
 import itertools
@@ -58,6 +69,10 @@ class Request:
     submit_seq: int = -1               # FIFO key (stable across preemption)
     admit_seq: int = -1                # youngest-victim key, per admission
     preemptions: int = 0
+    spilled: bool = False              # KV sits in the tiered store
+    spilled_tokens: int = 0            # context tokens the spill covers
+    spills: int = 0
+    restages: int = 0
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
 
@@ -93,8 +108,17 @@ class ServingScheduler:
         self._admit_counter = itertools.count()
         self.preemption_count = 0
         self.finished_count = 0
+        self.spill_count = 0
+        self.restage_count = 0
         # engine hook: called with the victim after each eviction (telemetry)
         self.on_preempt = None
+        # engine-installed tiering adapter (duck-typed: spill(req)->tier|None,
+        # begin_restage/restage_ready/restage(req), discard(req),
+        # describe_tiers()); None = destructive evict+recompute only
+        self.tiering = None
+        # engine-installed PrefixCache + hit callback(req, blocks)
+        self.prefix_cache = None
+        self.on_prefix_hit = None
 
     # ---- intake ----------------------------------------------------------- #
     def submit(self, req: Request) -> Request:
@@ -106,9 +130,29 @@ class ServingScheduler:
         return req
 
     def _pop_best_waiting(self) -> Optional[Request]:
+        """Best admittable waiting request.  A spilled request whose
+        restage has not landed is *skipped* (its prefetch is kicked here),
+        hiding the NVMe read behind decode of whoever comes next — the one
+        deliberate departure from strict head-of-line order.  When nothing
+        else is active the best request is taken regardless: blocking on
+        its restage beats idling the engine."""
         if not self.waiting:
             return None
-        best = min(self.waiting, key=lambda r: (r.priority, r.submit_seq))
+        order = sorted(self.waiting, key=lambda r: (r.priority, r.submit_seq))
+        best = None
+        if self.tiering is None:
+            best = order[0]
+        else:
+            for req in order:
+                if req.spilled and not self.tiering.restage_ready(req):
+                    self.tiering.begin_restage(req)
+                    continue
+                best = req
+                break
+            if best is None and not self.active:
+                best = order[0]
+        if best is None:
+            return None
         self.waiting.remove(best)
         return best
 
@@ -122,22 +166,71 @@ class ServingScheduler:
             if req is None:
                 break
             target = len(req.context)
+            prefix_blocks: List[int] = []
+            if (self.prefix_cache is not None and not req.spilled
+                    and not req.generated
+                    and not self.alloc.owned_blocks(req.rid)):
+                prefix_blocks = self.prefix_cache.lookup(req.prompt)
+                if prefix_blocks:
+                    self.alloc.adopt(req.rid, prefix_blocks)
+            fits = True
             while not self.alloc.allocate(req.rid, target):
+                if self._reclaim_prefix(req, target):
+                    continue
                 victim = self._admission_victim(req)
                 if victim is None:
-                    # Arena full and nothing evictable below this class:
-                    # head-of-line blocks until decode frees capacity.
-                    self.waiting.appendleft(req)
-                    return admitted
+                    fits = False
+                    break
                 self.preempt(victim)
+            if not fits:
+                # Arena full and nothing evictable below this class:
+                # head-of-line blocks until decode frees capacity.  Drop
+                # adopted prefix refs — the cache keeps its own pins, so
+                # the re-attach on the next attempt is just as free.
+                if prefix_blocks:
+                    self.alloc.free(req.rid)
+                self.waiting.appendleft(req)
+                return admitted
             req.slot = self._free_slots.pop()
             req.admit_seq = next(self._admit_counter)
             req.prefill_len = target
-            req.prefilled = 0
+            if req.spilled:
+                self._resume_from_spill(req)
+            elif prefix_blocks:
+                req.prefilled = len(prefix_blocks) * self.alloc.block_size
+                if self.on_prefix_hit is not None:
+                    self.on_prefix_hit(req, prefix_blocks)
+            else:
+                req.prefilled = 0
             req.state = PREFILL
             self.active[req.slot] = req
             admitted.append(req)
         return admitted
+
+    def _reclaim_prefix(self, req: Request, n_tokens: int) -> bool:
+        """First rung of the reclamation ladder: release LRU prefix-cache
+        pins to cover the shortfall.  Blocks the requester itself adopted
+        are not freed by this (it holds its own reference)."""
+        if self.prefix_cache is None:
+            return False
+        need = (self.alloc.blocks_for_tokens(n_tokens)
+                - len(self.alloc.owned_blocks(req.rid))
+                - self.alloc.free_blocks)
+        return need > 0 and self.prefix_cache.release(need) > 0
+
+    def _resume_from_spill(self, req: Request) -> None:
+        """Restore a spilled request's KV into its fresh allocation; a
+        failed restage (unreadable chunk) falls back to full recompute —
+        the pre-tiering path, still token-identical."""
+        ok = self.tiering is not None and self.tiering.restage(req)
+        if ok:
+            req.prefilled = req.spilled_tokens
+            req.restages += 1
+            self.restage_count += 1
+        else:
+            req.prefilled = 0
+        req.spilled = False
+        req.spilled_tokens = 0
 
     # ---- preemption ------------------------------------------------------- #
     def _victim_order(self, candidates: List[Request]) -> List[Request]:
@@ -158,10 +251,24 @@ class ServingScheduler:
         return order[0] if order else None
 
     def preempt(self, victim: Request) -> None:
-        """Evict ``victim``'s blocks and requeue it for recompute."""
+        """Reclaim ``victim``'s blocks and requeue it.  With tiering, the
+        spill rung runs first — the victim's written KV is captured to
+        host/NVMe so re-admission restores instead of recomputes; a
+        refused spill (budget, or nothing written yet) degrades to the
+        destructive pre-tiering evict.  ``prefilled`` resets to 0 either
+        way: until the restage actually lands, the arena holds nothing
+        for this request."""
         assert victim.slot in self.active and self.active[victim.slot] is victim
         del self.active[victim.slot]
         self._free_slots.append(victim.slot)
+        tier = None
+        if self.tiering is not None and victim.prefilled > 0:
+            tier = self.tiering.spill(victim)
+        victim.spilled = tier is not None
+        victim.spilled_tokens = victim.prefilled if victim.spilled else 0
+        if victim.spilled:
+            victim.spills += 1
+            self.spill_count += 1
         self.alloc.evict(victim.rid)
         victim.slot = -1
         victim.prefilled = 0
@@ -174,16 +281,23 @@ class ServingScheduler:
 
     def ensure_capacity(self, req: Request, n_tokens: int) -> None:
         """Guarantee ``req`` owns blocks for ``n_tokens`` context tokens,
-        evicting victims under arena pressure.  The victim order excludes
-        the requester, so the loop strictly shrinks the active set and
-        terminates; if the requester alone exceeds the arena we raise."""
+        walking the reclamation ladder under arena pressure.  The victim
+        order excludes the requester, so the loop strictly shrinks the
+        active set and terminates; if the requester alone exceeds the
+        arena we raise — host/NVMe tiers cannot substitute for device
+        residency of the decode window, so this holds even when every
+        other sequence has been spilled rather than destroyed."""
         while not self.alloc.allocate(req.rid, n_tokens):
+            if self._reclaim_prefix(req, n_tokens):
+                continue
             victim = self._growth_victim(req)
             if victim is None:
+                tiers = ("" if self.tiering is None else
+                         f"; tiers: {self.tiering.describe_tiers()}")
                 raise ArenaExhausted(
                     f"request {req.rid} needs "
                     f"{self.alloc.blocks_for_tokens(n_tokens)} blocks; arena "
-                    f"has {self.alloc.num_blocks - 1} usable")
+                    f"has {self.alloc.num_blocks - 1} usable{tiers}")
             self.preempt(victim)
 
     # ---- per-step work selection ------------------------------------------ #
@@ -210,6 +324,11 @@ class ServingScheduler:
         req.slot = -1
         req.state = FINISHED
         self.finished_count += 1
+        if self.tiering is not None:
+            # defensively drop any staged copy (e.g. a restage that fell
+            # back to recompute): finished bytes must never be readable
+            # under a later epoch of a reused block id
+            self.tiering.discard(req)
 
     # ---- introspection ---------------------------------------------------- #
     @property
@@ -225,4 +344,6 @@ class ServingScheduler:
             "blocks_free": self.alloc.free_blocks,
             "preemptions": self.preemption_count,
             "finished": self.finished_count,
+            "spills": self.spill_count,
+            "restages": self.restage_count,
         }

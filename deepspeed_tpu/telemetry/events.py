@@ -38,6 +38,9 @@ EF_RESET = "ef_reset"              # compression error-feedback zeroed at load
 SERVE_REQUEST = "serve_request"    # one completed ServingEngine request (TTFT)
 SERVE_STEP = "serve_step"          # serving-loop gauges (queue depth, blocks)
 SERVE_PREEMPT = "serve_preempt"    # SLO/arena preemption (blocks evicted)
+KV_SPILL = "kv_spill"              # preempted KV captured to host/NVMe tier
+KV_RESTAGE = "kv_restage"          # spilled KV restored on re-admission
+PREFIX_HIT = "prefix_hit"          # cached prompt blocks attached copy-free
 PROGRAM_CACHE = "program_cache_evict"  # inference per-shape LRU cache eviction
 OFFLOAD_STAGED = "offload_staged"  # per-step staging fold (bytes, ring hits)
 OFFLOAD_WAIT = "offload_wait"      # blocking stall on a staged read/write
@@ -46,8 +49,8 @@ SCHEMA = "schema"                  # JSONL header record (written by the sink)
 KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
          WORKER_EXIT, CKPT_SAVED, CKPT_RETRY, CKPT_ROLLBACK, PREEMPTION,
          ANOMALY, LR_BACKOFF, AUTO_ROLLBACK, BATCH_QUARANTINED, EF_RESET,
-         SERVE_REQUEST, SERVE_STEP, SERVE_PREEMPT, PROGRAM_CACHE,
-         OFFLOAD_STAGED, OFFLOAD_WAIT, SCHEMA)
+         SERVE_REQUEST, SERVE_STEP, SERVE_PREEMPT, KV_SPILL, KV_RESTAGE,
+         PREFIX_HIT, PROGRAM_CACHE, OFFLOAD_STAGED, OFFLOAD_WAIT, SCHEMA)
 
 # Every `step` record carries at least these keys once drained.
 STEP_REQUIRED_FIELDS = (

@@ -101,3 +101,104 @@ def test_consistency_detects_double_ownership():
     a._owned["b"] = list(a._owned["a"])   # corrupt: same block, two owners
     with pytest.raises(AssertionError):
         a.check_consistent()
+
+
+# ---- refcounted sharing (prefix cache substrate) ------------------------- #
+def test_ref_unref_shared_block_lifecycle():
+    a = make()
+    a.allocate("a", 4)
+    b = a.owned_blocks("a")[0]
+    a.ref(b)                              # cache pin
+    assert a.free("a") == 1               # owner gone, pin keeps it live
+    assert a.free_blocks == 6             # block NOT freed yet
+    a.check_consistent()
+    assert a.unref(b)                     # last reference frees it
+    assert a.free_blocks == 7
+    a.check_consistent()
+    with pytest.raises(AssertionError):
+        a.unref(b)                        # dead block
+    with pytest.raises(AssertionError):
+        a.ref(b)
+
+
+def test_adopt_shares_blocks_copy_free():
+    a = make()
+    a.allocate("a", 8)
+    shared = a.owned_blocks("a")
+    a.adopt("b", shared)
+    assert a.owned_blocks("b") == shared
+    assert a.blocks_in_use == 2           # no new physical blocks
+    a.check_consistent()
+    # adopter grows privately past the shared prefix
+    assert a.allocate("b", 12)
+    assert a.owned_blocks("b")[:2] == shared
+    assert a.owned_blocks("b")[2] not in shared
+    a.check_consistent()
+    # either side freeing leaves the other's view intact
+    a.free("a")
+    assert a.owned_blocks("b")[:2] == shared
+    a.check_consistent()
+    a.free("b")
+    assert a.free_blocks == 7
+    a.check_consistent()
+    # adopt must precede private growth
+    a.allocate("c", 4)
+    with pytest.raises(AssertionError):
+        a.adopt("c", [a.owned_blocks("c")[0]])
+
+
+def test_failed_growth_contract_under_sharing():
+    a = make(num_blocks=4)                # 3 usable
+    a.allocate("a", 8)                    # 2 blocks
+    a.adopt("b", a.owned_blocks("a"))
+    before = a.owned_blocks("b")
+    assert not a.allocate("b", 16)        # needs 2 more, only 1 free
+    assert a.owned_blocks("b") == before  # untouched on failure
+    a.check_consistent()
+
+
+def test_allocator_fuzz_random_interleavings():
+    """Random allocate/free/evict/adopt/ref/unref interleavings (the spill
+    path is free+re-allocate, so it is covered by construction), with
+    check_consistent after every operation."""
+    rng = np.random.default_rng(12345)
+    a = PagedKVAllocator(num_blocks=16, block_size=4, max_blocks_per_seq=8)
+    seqs = [f"s{i}" for i in range(6)]
+    pinned = []                           # blocks holding an extra ref
+    for _ in range(2000):
+        op = rng.integers(0, 5)
+        s = seqs[rng.integers(0, len(seqs))]
+        if op == 0:                       # allocate / grow
+            want = int(rng.integers(1, 33))
+            try:
+                a.allocate(s, want)
+            except ArenaExhausted:
+                pass
+        elif op == 1:
+            a.free(s)
+        elif op == 2:
+            a.evict(s)
+        elif op == 3:                     # cache-style pin of a live block
+            owned = a.owned_blocks(s)
+            if owned and len(pinned) < 8:
+                b = owned[int(rng.integers(0, len(owned)))]
+                a.ref(b)
+                pinned.append(b)
+        elif op == 4:                     # drop a pin
+            if pinned:
+                a.unref(pinned.pop(int(rng.integers(0, len(pinned)))))
+        if rng.integers(0, 4) == 0:       # adopt: shared prefix attach
+            src = seqs[rng.integers(0, len(seqs))]
+            dst = f"adopted{rng.integers(0, 3)}"
+            if a.owned_blocks(src) and not a.owned_blocks(dst):
+                a.adopt(dst, a.owned_blocks(src)[:2])
+            elif a.owned_blocks(dst):
+                a.free(dst)
+        a.check_consistent()
+    # teardown drains everything back to a full free list
+    for s in list(a._owned):
+        a.free(s)
+    for b in pinned:
+        a.unref(b)
+    a.check_consistent()
+    assert a.free_blocks == 15

@@ -1,0 +1,118 @@
+"""PrefixCache unit tests — trie lookup/insert semantics, refcount
+interplay with the allocator, LRU reclamation.  Pure host logic, no jax."""
+
+import pytest
+
+from deepspeed_tpu.serving.kv_cache import PagedKVAllocator
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
+
+
+def make(num_blocks=16, block_size=4, max_blocks=8, cache_cap=0):
+    alloc = PagedKVAllocator(num_blocks, block_size, max_blocks)
+    return alloc, PrefixCache(alloc, max_blocks=cache_cap)
+
+
+def prefill(alloc, seq, tokens):
+    """Simulate a prefill: allocate blocks covering ``tokens``."""
+    assert alloc.allocate(seq, len(tokens))
+    return alloc.owned_blocks(seq)
+
+
+def test_insert_and_lookup_full_blocks_only():
+    alloc, cache = make()
+    prompt = list(range(100, 110))            # 10 tokens, 2 full blocks
+    blocks = prefill(alloc, "a", prompt)
+    assert cache.insert(prompt, blocks) == 2  # the partial 3rd not cached
+    alloc.check_consistent()
+    hit = cache.lookup(prompt)
+    assert hit == blocks[:2]
+    # a prompt that diverges inside the second block matches one chunk
+    other = prompt[:6] + [999, 999]
+    assert cache.lookup(other) == blocks[:1]
+    # a diverging first block matches nothing
+    assert cache.lookup([1, 2, 3, 4, 5]) == []
+    assert cache.lookups == 3 and cache.hits == 2
+
+
+def test_lookup_capped_below_prompt_length():
+    """A prompt that is exactly N full blocks matches at most N-1: at
+    least one token must go through prefill so the completing chunk
+    yields the first generated token from real logits."""
+    alloc, cache = make()
+    prompt = list(range(8))                   # exactly 2 blocks
+    blocks = prefill(alloc, "a", prompt)
+    cache.insert(prompt, blocks)
+    assert cache.lookup(prompt) == blocks[:1]
+    longer = prompt + [77]
+    assert cache.lookup(longer) == blocks[:2]
+
+
+def test_insert_idempotent_no_double_pin():
+    alloc, cache = make()
+    prompt = list(range(8))
+    blocks = prefill(alloc, "a", prompt)
+    assert cache.insert(prompt, blocks) == 2
+    assert cache.insert(prompt, blocks) == 0      # same nodes, no new refs
+    # a second sequence with the same prompt keeps the ORIGINAL blocks
+    blocks_b = prefill(alloc, "b", prompt)
+    assert cache.insert(prompt, blocks_b) == 0
+    assert cache.lookup(prompt + [9]) == blocks
+    alloc.check_consistent()
+    # both sequences and the cache can unwind without leaking
+    alloc.free("a")
+    alloc.free("b")
+    cache.release(100)
+    alloc.check_consistent()
+    assert alloc.free_blocks == alloc.num_blocks - 1
+
+
+def test_blocks_survive_owner_finish():
+    alloc, cache = make()
+    prompt = list(range(12))
+    blocks = prefill(alloc, "a", prompt)
+    cache.insert(prompt, blocks)
+    alloc.free("a")                           # request finished
+    alloc.check_consistent()
+    hit = cache.lookup(prompt + [1])
+    assert hit == blocks                      # cache pins kept them live
+    alloc.adopt("b", hit)
+    alloc.check_consistent()
+
+
+def test_release_lru_order_and_shared_blocks_not_freed():
+    alloc, cache = make()
+    p1, p2 = list(range(4)), list(range(50, 54))
+    b1 = prefill(alloc, "a", p1 + [9])
+    b2 = prefill(alloc, "b", p2 + [9])
+    cache.insert(p1 + [9], b1)
+    cache.insert(p2 + [9], b2)
+    alloc.free("a")
+    alloc.free("b")
+    cache.lookup(p1 + [8])                    # touch p1: p2 becomes LRU
+    assert cache.release(1) == 1
+    assert cache.lookup(p2 + [8]) == []       # LRU victim was p2
+    assert cache.lookup(p1 + [8]) == b1[:1]
+    # a pinned-by-a-sequence block is unrefed but not freed; release keeps
+    # walking until a block actually returns to the free list
+    alloc.adopt("c", cache.lookup(p1 + [8]))
+    freed = cache.release(1)
+    assert freed == 0 and cache.cached_blocks == 0
+    alloc.check_consistent()
+
+
+def test_max_blocks_cap_evicts_lru():
+    alloc, cache = make(cache_cap=2)
+    p1 = list(range(12))                      # 3 full... cap trims
+    b1 = prefill(alloc, "a", p1 + [1])
+    cache.insert(p1 + [1], b1)
+    assert cache.cached_blocks == 2           # cap enforced at insert
+    alloc.free("a")
+    alloc.check_consistent()
+
+
+def test_stats_shape():
+    alloc, cache = make()
+    s = cache.stats()
+    assert s == {"prefix_lookups": 0, "prefix_hits": 0,
+                 "prefix_cached_blocks": 0, "prefix_insertions": 0,
+                 "prefix_released_blocks": 0}

@@ -6,6 +6,7 @@ import pytest
 from deepspeed_tpu.serving import (DeepSpeedServingConfig, PagedKVAllocator,
                                    QueueFull, Request, ServingScheduler)
 from deepspeed_tpu.serving.kv_cache import ArenaExhausted
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.scheduler import DECODE, PREFILL, WAITING
 
 
@@ -159,3 +160,182 @@ def test_finish_releases_slot_and_blocks():
     assert s.stats()["finished"] == 1
     s.submit(req(2))
     assert [r.rid for r in s.admit()] == [2]     # slot is reusable
+
+
+# ---- tiering rung (engine-installed duck-typed adapter) ------------------- #
+class FakeTiering:
+    def __init__(self, accept=True, ready=True, restage_ok=True):
+        self.accept, self.ready_flag, self.restage_ok = accept, ready, restage_ok
+        self.spilled, self.kicked = [], []
+        self.restaged, self.discarded = [], []
+
+    def spill(self, req):
+        self.spilled.append((req.rid, req.prefilled))
+        return "host" if self.accept else None
+
+    def begin_restage(self, req):
+        self.kicked.append(req.rid)
+
+    def restage_ready(self, req):
+        return self.ready_flag
+
+    def restage(self, req):
+        self.restaged.append(req.rid)
+        return self.restage_ok
+
+    def discard(self, req):
+        self.discarded.append(req.rid)
+
+    def describe_tiers(self):
+        return "host=2 nvme=1"
+
+
+def test_preempt_spills_written_kv_before_evict():
+    s = make(num_blocks=5, slots=3)
+    s.tiering = FakeTiering()
+    old, young = req(1, n=8), req(2, n=8)
+    s.submit(old)
+    s.submit(young)
+    s.admit()
+    young.prefilled = 8                          # pretend prefill ran
+    s.ensure_capacity(old, 9)                    # victim = young, never old
+    assert s.tiering.spilled == [(2, 8)]         # spill rung saw the KV...
+    assert young.spilled and young.spilled_tokens == 8
+    assert young.prefilled == 0                  # ...but the arena holds none
+    assert young.spills == 1 and s.spill_count == 1
+    assert young.state == WAITING
+    s.alloc.check_consistent()
+
+
+def test_preempt_nothing_written_skips_spill():
+    s = make(num_blocks=5, slots=3)
+    s.tiering = FakeTiering()
+    s.submit(req(1, n=8))
+    young = req(2, n=8)
+    s.submit(young)
+    old = s.admit()[0]
+    s.ensure_capacity(old, 9)                    # young.prefilled == 0
+    assert s.tiering.spilled == []
+    assert not young.spilled and s.spill_count == 0
+
+
+def test_spill_refusal_degrades_to_destructive_evict():
+    s = make(num_blocks=5, slots=3)
+    s.tiering = FakeTiering(accept=False)        # budget says no
+    old, young = req(1, n=8), req(2, n=8)
+    s.submit(old)
+    s.submit(young)
+    s.admit()
+    young.prefilled = 8
+    s.ensure_capacity(old, 9)
+    assert not young.spilled and young.spilled_tokens == 0
+    assert s.spill_count == 0 and s.preemption_count == 1
+
+
+def test_spilled_not_ready_is_skipped_and_prefetch_kicked():
+    s = make(slots=2)
+    s.tiering = FakeTiering(ready=False)
+    a, b = req(1), req(2)
+    s.submit(a)
+    s.submit(b)
+    s.admit()                                    # both active
+    a.prefilled = 6
+    s.preempt(a)                                 # spilled, head of queue
+    s.submit(req(3))
+    admitted = s.admit()                         # a's bytes not resident:
+    assert [r.rid for r in admitted] == [3]      # later arrival overtakes
+    assert s.tiering.kicked == [1]               # but its prefetch is kicked
+    assert a in s.waiting and a.spilled
+
+
+def test_spilled_forced_when_engine_idle_and_restage_restores():
+    s = make(slots=1)
+    s.tiering = FakeTiering(ready=False)
+    a = req(1)
+    s.submit(a)
+    s.admit()
+    a.prefilled = 6
+    s.preempt(a)
+    assert s.admit() == [a]                      # idle: block on the restage
+    assert s.tiering.restaged == [1]
+    assert a.prefilled == 6                      # restored, not recomputed
+    assert not a.spilled and a.restages == 1 and s.restage_count == 1
+
+
+def test_failed_restage_falls_back_to_recompute():
+    s = make(slots=1)
+    s.tiering = FakeTiering(restage_ok=False)
+    a = req(1, n=8)
+    s.submit(a)
+    s.admit()
+    a.prefilled = 8
+    s.preempt(a)
+    assert s.admit() == [a]
+    assert a.prefilled == 0 and not a.spilled    # pre-tiering path
+    assert s.restage_count == 0
+
+
+def test_finish_discards_staged_copy():
+    s = make(slots=1)
+    s.tiering = FakeTiering()
+    a = req(1)
+    s.submit(a)
+    s.admit()
+    a.state = DECODE
+    s.finish(a)
+    assert s.tiering.discarded == [1]
+
+
+def test_arena_exhausted_reports_tier_occupancy():
+    s = make(num_blocks=3, slots=2)
+    s.tiering = FakeTiering()
+    only = req(1, n=8)
+    s.submit(only)
+    s.admit()
+    with pytest.raises(ArenaExhausted, match="tiers: host=2 nvme=1"):
+        s.ensure_capacity(only, 12)
+
+
+# ---- prefix-cache integration --------------------------------------------- #
+def warm_cache(s, prompt, rid=100):
+    """Run a request through so its prompt blocks sit in the prefix cache."""
+    warm = Request(rid=rid, prompt=list(prompt), max_new_tokens=1)
+    s.submit(warm)
+    s.admit()
+    warm.prefilled = len(prompt)
+    blocks = s.alloc.owned_blocks(rid)
+    s.prefix_cache.insert(warm.prompt, blocks)
+    warm.state = DECODE
+    s.finish(warm)
+    return blocks
+
+
+def test_admit_adopts_cached_prefix_and_skips_prefill():
+    s = make(slots=2)
+    s.prefix_cache = PrefixCache(s.alloc)
+    hits = []
+    s.on_prefix_hit = lambda r, blocks: hits.append((r.rid, list(blocks)))
+    prompt = list(range(1, 10))                  # 9 tokens, 2 full blocks
+    warm_blocks = warm_cache(s, prompt)
+    r = Request(rid=101, prompt=list(prompt), max_new_tokens=4)
+    s.submit(r)
+    assert s.admit() == [r]
+    assert s.alloc.owned_blocks(101)[:2] == warm_blocks[:2]  # copy-free
+    assert r.prefilled == 8                      # only the tail prefills
+    assert hits == [(101, warm_blocks[:2])]
+    s.alloc.check_consistent()
+
+
+def test_deferred_admission_releases_adopted_refs():
+    s = make(num_blocks=4, slots=2)              # 3 usable blocks
+    s.prefix_cache = PrefixCache(s.alloc)
+    warm_cache(s, list(range(1, 10)))            # cache pins 2 blocks
+    s.submit(req(1, n=4))                        # takes the last free block
+    s.admit()
+    assert s.alloc.free_blocks == 0
+    cold = req(2, n=9)                           # adopts 2, needs a 3rd
+    s.submit(cold)
+    assert s.admit() == []                       # same class: no victim
+    assert s.alloc.owned_blocks(2) == []         # adopted refs dropped
+    assert s.waiting[0] is cold
+    s.alloc.check_consistent()

@@ -57,9 +57,14 @@ def test_report_folds_and_passes_gates(tool, tmp_path, capsys):
     assert report["preemptions"] == 1 and report["preemption_rate"] == 0.25
     assert report["p99_ttft_ms"] == 30.0
     assert report["peaks"] == {"queue_depth": 3, "active": 4,
-                               "blocks_in_use": 17}
+                               "blocks_in_use": 17,
+                               "kv_host_bytes": 0, "kv_nvme_bytes": 0}
     assert set(report["by_slo"]) == {"standard", "realtime", "batch"}
     assert report["by_slo"]["standard"]["finished"] == 2
+    # no tiering records: zero-valued columns, stall frac 0 by definition
+    assert report["kv_spills"] == 0 and report["kv_restages"] == 0
+    assert report["restage_stall_frac"] == 0.0
+    assert report["prefix_hit_rate"] is None
 
 
 def test_gate_failure_exits_1(tool, tmp_path, capsys):
@@ -76,6 +81,64 @@ def test_json_out_and_torn_tail(tool, tmp_path):
     out = tmp_path / "report.json"
     assert tool.main([path, "--json", str(out)]) == 0
     assert json.loads(out.read_text())["finished"] == 4
+
+
+def tiering_records():
+    """sample_records() plus a spill/restage/prefix-hit story."""
+    recs = sample_records()
+    recs.append({"kind": "kv_spill", "rid": 2, "slo": "batch", "tier": "host",
+                 "blocks": 3, "tokens": 40, "bytes": 3000})
+    recs.append({"kind": "kv_spill", "rid": 3, "slo": "batch", "tier": "nvme",
+                 "blocks": 2, "tokens": 20, "bytes": 2000})
+    recs.append({"kind": "kv_restage", "rid": 2, "ok": True, "source": "host",
+                 "ready": True, "wait_ms": 1.0, "blocks": 3, "bytes": 3000})
+    recs.append({"kind": "kv_restage", "rid": 3, "ok": True, "source": "nvme",
+                 "ready": False, "wait_ms": 9.0, "blocks": 2, "bytes": 2000})
+    recs.append({"kind": "kv_restage", "rid": 9, "ok": False,
+                 "error": "CRC mismatch"})
+    recs.append({"kind": "prefix_hit", "rid": 3, "blocks": 2, "tokens": 32})
+    recs.append({"kind": "serve_step", "queue_depth": 0, "active": 1,
+                 "blocks_in_use": 4, "kv_host_bytes": 3000,
+                 "kv_nvme_bytes": 2000, "elapsed_ms": 1000.0,
+                 "prefix_lookups": 4, "prefix_hits": 1})
+    return recs
+
+
+def test_tiering_columns_and_gates_pass(tool, tmp_path, capsys):
+    path = write_jsonl(tmp_path / "t.jsonl", tiering_records())
+    rc = tool.main([path, "--max-restage-stall-frac", "0.05",
+                    "--min-prefix-hit-rate", "0.2"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["kv_spills"] == 2
+    assert report["kv_spill_bytes_by_tier"] == {"host": 3000, "nvme": 2000}
+    assert report["kv_restages"] == 2 and report["kv_restage_failures"] == 1
+    assert report["kv_restage_sources"] == {"host": 1, "nvme": 1}
+    assert report["p99_restage_wait_ms"] == 9.0
+    assert report["restage_stall_frac"] == 0.01      # 10ms over 1000ms
+    assert report["prefix_hit_rate"] == 0.25
+    assert report["peaks"]["kv_host_bytes"] == 3000
+    assert report["peaks"]["kv_nvme_bytes"] == 2000
+
+
+def test_tiering_gate_failures(tool, tmp_path, capsys):
+    path = write_jsonl(tmp_path / "t.jsonl", tiering_records())
+    assert tool.main([path, "--max-restage-stall-frac", "0.001"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["gates"]["max_restage_stall_frac"]["ok"]
+    assert tool.main([path, "--min-prefix-hit-rate", "0.5"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["gates"]["min_prefix_hit_rate"]["ok"]
+    # waits recorded but no elapsed_ms gauge to normalize by: gate fails
+    recs = [r for r in tiering_records()
+            if not (r["kind"] == "serve_step" and "elapsed_ms" in r)]
+    path2 = write_jsonl(tmp_path / "t2.jsonl", recs)
+    assert tool.main([path2, "--max-restage-stall-frac", "0.9"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["restage_stall_frac"] is None
+    # no prefix lookups at all: hit-rate gate fails rather than passes
+    path3 = write_jsonl(tmp_path / "t3.jsonl", sample_records())
+    assert tool.main([path3, "--min-prefix-hit-rate", "0.1"]) == 1
 
 
 def test_usage_errors_exit_2(tool, tmp_path):

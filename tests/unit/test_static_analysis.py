@@ -192,6 +192,41 @@ class TestLockDisciplinePass:
         finds = lock_discipline.check_scanned_file(sf, ctx, set())
         assert len(finds) == 1 and "_n" in finds[0].message
 
+    def test_serving_tree_is_in_scope(self):
+        """PR 12 widened the lock-discipline roots to the serving tier:
+        the KV tiering manager (the one serving class with a real lock
+        protocol) must be among the scanned files."""
+        files = lock_discipline.checked_files(REPO_ROOT)
+        rel = {os.path.relpath(f, REPO_ROOT).replace(os.sep, "/")
+               for f in files}
+        assert "deepspeed_tpu/serving/kv_tiering.py" in rel
+        assert any(p.startswith("deepspeed_tpu/runtime/offload/")
+                   for p in rel)
+
+    def test_seeded_tiering_shape_violations(self, tmp_path):
+        """A miniature of the kv_tiering lock protocol with the two bugs
+        the pass exists to catch: a store read (blocking D2H/NVMe wait)
+        under the manager lock, and a record-table mutation outside it."""
+        sf, ctx = _scan(tmp_path, (
+            "import threading\n"
+            "class Tier:\n"
+            "    def __init__(self, store):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._store = store\n"
+            "        self._seqs = {}  # guarded-by: _lock\n"
+            "    def bad_restage(self, rid, fut):\n"
+            "        with self._lock:\n"
+            "            rec = self._seqs[rid]\n"
+            "            data = fut.result()\n"      # NVMe wait under lock
+            "            return rec, data\n"
+            "    def bad_discard(self, rid):\n"
+            "        return self._seqs.pop(rid, None)\n"))
+        finds = lock_discipline.check_scanned_file(sf, ctx, set())
+        msgs = [f.message for f in finds]
+        assert len(finds) == 2, msgs
+        assert any("blocking call" in m and "bad_restage" in m for m in msgs)
+        assert any("_seqs" in m and "bad_discard" in m for m in msgs)
+
     def test_guard_naming_a_nonlock_is_flagged(self, tmp_path):
         sf, ctx = _scan(tmp_path, (
             "class R:\n"

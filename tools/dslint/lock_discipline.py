@@ -24,10 +24,12 @@ four share two shapes this pass detects statically:
    too.  The condition-variable idiom (``self._cond.wait()`` inside
    ``with self._cond:``) is exempt — wait() releases the held lock.
 
-Scope: every ``.py`` under ``runtime/offload/`` and
-``runtime/swap_tensor/``.  Annotations are opt-in per field — classes
-with documented single-thread ownership (the trainer-thread swappers)
-simply carry no ``guarded-by`` annotations.
+Scope: every ``.py`` under ``runtime/offload/``, ``runtime/swap_tensor/``
+and ``serving/`` (the KV tiering manager shares its bookkeeping with the
+staging workers, so its locks carry contracts from day one).  Annotations
+are opt-in per field — classes with documented single-thread ownership
+(the trainer-thread swappers, the scheduler/engine pair) simply carry no
+``guarded-by`` annotations.
 
 Escape hatch: ``# dslint: ok(lock-discipline) — <reason>``.
 """
@@ -46,6 +48,7 @@ PASS_NAME = "lock-discipline"
 CHECKED_DIRS: Sequence[str] = (
     "deepspeed_tpu/runtime/offload",
     "deepspeed_tpu/runtime/swap_tensor",
+    "deepspeed_tpu/serving",
 )
 
 _GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_]\w*)")
@@ -303,7 +306,7 @@ class LockDisciplinePass(LintPass):
     name = PASS_NAME
     description = ("guarded-by field annotations enforced at every access "
                    "site; no blocking call while a lock is held "
-                   "(runtime/offload, runtime/swap_tensor)")
+                   "(runtime/offload, runtime/swap_tensor, serving)")
 
     def run(self, ctx: Context) -> List[Finding]:
         rels = checked_files(ctx.repo_root)

@@ -8,14 +8,25 @@ tokens/s, queue-depth and arena-occupancy peaks, preemption counts.  Same
 family as ``tools/stability_report.py``: forensics over run artifacts, no
 jax required.
 
+With tiering/prefix-cache records present (``kv_spill``, ``kv_restage``,
+``prefix_hit``) the fold adds the oversubscription columns: restage wait
+p50/p99, bytes spilled per landing tier, restage-stall fraction (blocking
+restage wait over run wall-clock) and the prefix hit rate.
+
 Usage::
 
     python tools/serve_report.py TELEMETRY_JSONL
-        [--p99-ttft-ms X] [--max-preemption-rate X] [--json OUT]
+        [--p99-ttft-ms X] [--max-preemption-rate X]
+        [--max-restage-stall-frac X] [--min-prefix-hit-rate X] [--json OUT]
 
-Gates (optional): ``--p99-ttft-ms`` fails (exit 1) when the p99
-time-to-first-token exceeds the bound; ``--max-preemption-rate`` fails
-when preemptions per finished request exceed the bound.  Exit 2 on usage
+Gates (optional, same contract as ``offload_audit.py``): ``--p99-ttft-ms``
+fails (exit 1) when the p99 time-to-first-token exceeds the bound;
+``--max-preemption-rate`` fails when preemptions per finished request
+exceed the bound; ``--max-restage-stall-frac`` fails when blocking
+restage time exceeds that fraction of the run (or when waits exist but
+the run emitted no wall-clock gauge to normalize by);
+``--min-prefix-hit-rate`` fails when prefix hits / lookups falls below
+the bound (or when no lookups were recorded at all).  Exit 2 on usage
 errors (unreadable file / not a telemetry JSONL / no serving records).
 
 Standard library only.
@@ -64,8 +75,16 @@ def fold(records):
     ttfts, latencies, tps = [], [], []
     new_tokens = 0
     by_slo = {}
-    peak = {"queue_depth": 0, "active": 0, "blocks_in_use": 0}
+    peak = {"queue_depth": 0, "active": 0, "blocks_in_use": 0,
+            "kv_host_bytes": 0, "kv_nvme_bytes": 0}
     steps = 0
+    spills = restages = restage_failures = prefix_hits = 0
+    spill_bytes_by_tier = {}
+    restage_bytes = 0
+    restage_waits = []
+    restage_sources = {}
+    elapsed_ms = None          # last serve_step gauge wins (monotonic)
+    prefix_lookups = prefix_hits_gauge = None
     for rec in records:
         kind = rec.get("kind")
         if kind == "serve_request":
@@ -86,6 +105,23 @@ def fold(records):
                     tps.append(float(rec["tokens_per_sec"]))
         elif kind == "serve_preempt":
             preempts += 1
+        elif kind == "kv_spill":
+            spills += 1
+            tier = str(rec.get("tier", "unknown"))
+            spill_bytes_by_tier[tier] = (spill_bytes_by_tier.get(tier, 0)
+                                         + int(rec.get("bytes", 0)))
+        elif kind == "kv_restage":
+            if rec.get("ok"):
+                restages += 1
+                restage_bytes += int(rec.get("bytes", 0))
+                src = str(rec.get("source", "unknown"))
+                restage_sources[src] = restage_sources.get(src, 0) + 1
+                if "wait_ms" in rec:
+                    restage_waits.append(float(rec["wait_ms"]))
+            else:
+                restage_failures += 1
+        elif kind == "prefix_hit":
+            prefix_hits += 1
         elif kind == "serve_step":
             steps += 1
             for key in peak:
@@ -93,6 +129,11 @@ def fold(records):
                     peak[key] = max(peak[key], int(rec.get(key, 0)))
                 except (TypeError, ValueError):
                     pass
+            if "elapsed_ms" in rec:
+                elapsed_ms = float(rec["elapsed_ms"])
+            if "prefix_lookups" in rec:
+                prefix_lookups = int(rec["prefix_lookups"])
+                prefix_hits_gauge = int(rec.get("prefix_hits", 0))
 
     ttfts.sort()
     latencies.sort()
@@ -100,6 +141,18 @@ def fold(records):
         vals = sorted(s.pop("ttft_ms"))
         s["p50_ttft_ms"] = _pct(vals, 0.50)
         s["p99_ttft_ms"] = _pct(vals, 0.99)
+    restage_waits.sort()
+    total_wait_ms = sum(restage_waits)
+    if not restage_waits:
+        stall_frac = 0.0
+    elif elapsed_ms:
+        stall_frac = round(total_wait_ms / elapsed_ms, 4)
+    else:
+        stall_frac = None   # waits with nothing to normalize by: gate fails
+    if prefix_lookups:
+        prefix_hit_rate = round(prefix_hits_gauge / prefix_lookups, 4)
+    else:
+        prefix_hit_rate = None
     return {
         "submitted": submitted,
         "finished": finished,
@@ -115,6 +168,18 @@ def fold(records):
         "by_slo": by_slo,
         "gauge_steps": steps,
         "peaks": peak,
+        "kv_spills": spills,
+        "kv_spill_bytes_by_tier": spill_bytes_by_tier,
+        "kv_restages": restages,
+        "kv_restage_failures": restage_failures,
+        "kv_restage_bytes": restage_bytes,
+        "kv_restage_sources": restage_sources,
+        "p50_restage_wait_ms": _pct(restage_waits, 0.50),
+        "p99_restage_wait_ms": _pct(restage_waits, 0.99),
+        "restage_stall_frac": stall_frac,
+        "prefix_hits": prefix_hits,
+        "prefix_hit_rate": prefix_hit_rate,
+        "elapsed_ms": elapsed_ms,
     }
 
 
@@ -126,6 +191,12 @@ def main(argv=None) -> int:
                     help="fail (exit 1) if p99 TTFT exceeds this bound")
     ap.add_argument("--max-preemption-rate", type=float, default=None,
                     help="fail (exit 1) if preemptions/finished exceeds this")
+    ap.add_argument("--max-restage-stall-frac", type=float, default=None,
+                    help="fail (exit 1) if blocking restage wait exceeds "
+                         "this fraction of run wall-clock")
+    ap.add_argument("--min-prefix-hit-rate", type=float, default=None,
+                    help="fail (exit 1) if prefix hits/lookups falls below "
+                         "this (or no lookups were recorded)")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="also write the report to this file")
     args = ap.parse_args(argv)
@@ -154,6 +225,20 @@ def main(argv=None) -> int:
             "limit": args.max_preemption_rate,
             "value": report["preemption_rate"],
             "ok": report["preemption_rate"] <= args.max_preemption_rate,
+        }
+    if args.max_restage_stall_frac is not None:
+        val = report["restage_stall_frac"]
+        gates["max_restage_stall_frac"] = {
+            "limit": args.max_restage_stall_frac,
+            "value": val,
+            "ok": val is not None and val <= args.max_restage_stall_frac,
+        }
+    if args.min_prefix_hit_rate is not None:
+        val = report["prefix_hit_rate"]
+        gates["min_prefix_hit_rate"] = {
+            "limit": args.min_prefix_hit_rate,
+            "value": val,
+            "ok": val is not None and val >= args.min_prefix_hit_rate,
         }
     report["gates"] = gates
     report["ok"] = all(g["ok"] for g in gates.values())
