@@ -34,6 +34,11 @@ parses the final line — and every record persisted to
                 says whether p99 TTFT stayed under it.
   vs_baseline = p99 TTFT bound / measured p99 TTFT (>= 1 means the SLO
                 held with margin).
+  Unless BENCH_SERVE_OBS=0 the rung also runs the live observability
+  plane: an ops server scraped mid-run (``obs.scrape_ok`` = populated
+  TTFT histograms + arena/tier gauges on /metrics, ``obs.healthy`` =
+  /healthz) and the ``tools/obs_report.py`` burn-rate replay as the
+  post-rung SLO gate (``obs.slo``).
 * ``offload``: beyond-HBM tiered offload (``runtime/offload``) — the same
   layered stage-3 step with the parameter+optimizer state on the NVMe
   tier vs fully in HBM, plus the ZeRO-Infinity refused-without /
@@ -422,19 +427,34 @@ def bench_serve():
     from deepspeed_tpu.models.gpt import GPT, gpt_config
     from deepspeed_tpu.serving import DeepSpeedServingConfig, ServingEngine
 
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
+    from deepspeed_tpu.telemetry import TelemetryHub
+
     n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "32"))
     rate = float(os.environ.get("BENCH_SERVE_RATE", "16"))
     bound_ms = float(os.environ.get("BENCH_SERVE_P99_TTFT_MS", "2000"))
     new_max = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "32"))
     slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+    with_obs = os.environ.get("BENCH_SERVE_OBS", "1") != "0"
 
     cfg = gpt_config("tiny", scan_layers=True)
     model = GPT(cfg)
     scfg = DeepSpeedServingConfig(
         block_size=16, num_blocks=1 + slots * (cfg.n_positions // 16),
-        max_batch_size=slots, prefill_chunk=32,
+        max_batch_size=slots, prefill_chunk=32, telemetry_every=4,
         dtype=os.environ.get("BENCH_DTYPE", "bfloat16"))
-    eng = ServingEngine(model, config=scfg)
+    # live observability plane: metrics registry + loopback ops server,
+    # scraped mid-run below; the JSONL feeds the obs_report SLO gate.
+    tmp = tempfile.mkdtemp(prefix="bench_serve_") if with_obs else None
+    hub = None
+    if with_obs:
+        hub = TelemetryHub.from_config(DeepSpeedTelemetryConfig(
+            enabled=True, jsonl_path=os.path.join(tmp, "telemetry.jsonl"),
+            flush_every=4, ops_server=True, ops_port=0))
+    eng = ServingEngine(model, config=scfg, telemetry=hub)
 
     rng = np.random.default_rng(0)
     arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
@@ -446,7 +466,7 @@ def bench_serve():
     eng.submit(prompts[0][:4], max_new_tokens=2).result()   # compile both traces
 
     t0 = time.perf_counter()
-    futs, i = [], 0
+    futs, i, obs = [], 0, None
     while i < n_req or not all(f.done for f in futs):
         now = time.perf_counter() - t0
         while i < n_req and arrivals[i] <= now:
@@ -457,6 +477,9 @@ def bench_serve():
                 time.sleep(min(arrivals[i] - now, 0.01))
             continue
         eng.step()
+        if (obs is None and hub is not None
+                and sum(f.done for f in futs) >= n_req // 2):
+            obs = _scrape_obs(hub)          # mid-run, engine still serving
     elapsed = time.perf_counter() - t0
 
     ttfts = sorted(f.request.first_token_at - f.request.arrival for f in futs)
@@ -477,10 +500,72 @@ def bench_serve():
         "preemptions": eng.sched.preemption_count,
         "compiled_programs": eng.compiled_programs(),
     }
+    if hub is not None:
+        if obs is None:                     # short run: scrape before close
+            obs = _scrape_obs(hub)
+        jsonl = os.path.join(tmp, "telemetry.jsonl")
+        eng.close()
+        hub.close()
+        obs["slo"] = _obs_report_gate(jsonl, bound_ms)
+        obs["ok"] = bool(obs.get("scrape_ok") and obs.get("healthy")
+                         and obs["slo"].get("ok"))
+        rec["obs"] = obs
+        shutil.rmtree(tmp, ignore_errors=True)
     if os.environ.get("BENCH_SERVE_OVERSUB", "1") != "0":
         rec["oversub"] = bench_serve_oversub()
     print(json.dumps(rec))
     return rec
+
+
+def _scrape_obs(hub):
+    """Hit the live ops server over HTTP: /metrics must carry populated
+    TTFT histograms + arena/tier gauges, /healthz must be healthy."""
+    import re as _re
+    import urllib.request
+
+    out = {"url": hub.obs_server.url, "scrape_ok": False, "healthy": False}
+    try:
+        with urllib.request.urlopen(f"{hub.obs_server.url}/metrics",
+                                    timeout=5) as r:
+            text = r.read().decode()
+        m = _re.search(r"^dstpu_serve_ttft_ms_count (\d+)", text,
+                       _re.MULTILINE)
+        out["ttft_hist_count"] = int(m.group(1)) if m else 0
+        out["arena_gauge"] = "dstpu_serve_blocks_in_use" in text
+        out["tier_gauges"] = ("dstpu_serve_kv_host_bytes" in text
+                              and "dstpu_serve_kv_nvme_bytes" in text)
+        out["scrape_ok"] = (out["ttft_hist_count"] > 0 and out["arena_gauge"]
+                            and out["tier_gauges"])
+        with urllib.request.urlopen(f"{hub.obs_server.url}/healthz",
+                                    timeout=5) as r:
+            out["healthy"] = bool(json.loads(r.read().decode())["healthy"])
+    except Exception as e:            # noqa: BLE001 — fold into the record
+        out["error"] = str(e)
+    return out
+
+
+def _obs_report_gate(jsonl_path, p99_ttft_ms):
+    """Post-rung SLO gate: replay the rung's telemetry through
+    ``tools/obs_report.py`` (same loading idiom as the offload audit)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    records, err = mod.load_records(jsonl_path)
+    if err:
+        return {"ok": False, "error": err}
+    monitor, evaluations = mod.replay(
+        records, mod._slo.default_rules(serve_p99_ttft_ms=p99_ttft_ms))
+    verdict = monitor.verdict()
+    violated = sorted(n for n, r in verdict["rules"].items()
+                      if r.get("violated"))
+    return {"ok": bool(verdict["ok"] and verdict["burn_events"] == 0
+                       and not violated),
+            "violated": violated, "burn_events": verdict["burn_events"],
+            "evaluations": evaluations}
 
 
 def bench_serve_oversub():

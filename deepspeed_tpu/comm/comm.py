@@ -163,6 +163,20 @@ def configure_comms_logger(comms_logger):
     _COMMS_LOGGER = comms_logger
 
 
+_METRICS_REGISTRY = None
+
+
+def configure_metrics_registry(registry):
+    """Attach the live MetricsRegistry: every staged collective then
+    increments ``comm_bytes_total{op=...}`` / ``comm_ops_total{op=...}``.
+    Same trace-time semantics as the CommsLogger append in ``_log_op`` —
+    counts mark when collectives were *staged* into an XLA program (run
+    time shows up in profiler captures, and measured latencies reach the
+    registry through the ``comm_summary`` fold)."""
+    global _METRICS_REGISTRY
+    _METRICS_REGISTRY = registry
+
+
 @contextmanager
 def _log_op(name: str, tensor, group=None):
     """Per-collective instrumentation: appends (op, bytes) to the
@@ -178,6 +192,10 @@ def _log_op(name: str, tensor, group=None):
         nbytes = 0
     if _COMMS_LOGGER is not None:
         _COMMS_LOGGER.append(name, nbytes)
+    if _METRICS_REGISTRY is not None:
+        _METRICS_REGISTRY.counter("comm_bytes_total",
+                                  {"op": name}).inc(nbytes)
+        _METRICS_REGISTRY.counter("comm_ops_total", {"op": name}).inc()
     tracer = get_global_tracer()
     if tracer is None:
         yield

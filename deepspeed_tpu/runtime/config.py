@@ -16,7 +16,7 @@ sizes (data/fsdp/tensor/pipe/expert/seq), replacing the reference's implicit
 
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config_utils import (DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys,
@@ -82,8 +82,19 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigModel):
     """
     enabled: bool = False
     jsonl_path: str = ""                 # rank-0 JSONL sink ("" disables)
+    jsonl_max_bytes: int = 0             # rotate the sink past this (0 → off)
+    jsonl_keep: int = 5                  # rotated files kept (beyond live)
     ring_buffer_size: int = 1024         # in-memory sink (0 disables)
     flush_every: int = 0                 # 0 → follow steps_per_print (or 50)
+    # live metrics plane (README § Observability)
+    metrics: bool = True                 # MetricsRegistry fed off the drain
+    snapshot_every: int = 0              # cross-rank fold cadence, steps (0 off)
+    ops_server: bool = False             # stdlib HTTP /metrics /healthz /slo
+    ops_host: str = "127.0.0.1"
+    ops_port: int = 0                    # 0 → ephemeral (logged at startup)
+    slo_rules: List[Dict[str, Any]] = Field(default_factory=list)
+    # empty slo_rules → telemetry/slo.py default_rules(); entries use the
+    # rule grammar documented in that module (README § Observability)
     # windowed XLA profiler capture over [start, end) global steps
     profiler_start_step: int = 0
     profiler_end_step: int = 0           # 0 → profiler disabled

@@ -266,6 +266,11 @@ class DeepSpeedEngine:
                 batch_size=self.train_batch_size(),
                 steps_per_print=self._config.steps_per_print)
             self.profiler_window = ProfilerWindow.from_config(tcfg)
+            if self.telemetry.registry is not None:
+                # live per-op wire-byte counters off the comm facade
+                from deepspeed_tpu.comm import comm as comm_backend
+                comm_backend.configure_metrics_registry(
+                    self.telemetry.registry)
 
         # ---- training-stability sentinel -------------------------------- #
         # None when disabled: the step programs are then built with the
@@ -331,6 +336,25 @@ class DeepSpeedEngine:
                 if tcfg.watchdog_signal_dump:
                     self.watchdog.install_signal_handlers()
                 self.watchdog.start()
+
+        # ---- live observability plane ----------------------------------- #
+        # The hub built the registry / SLO monitor / ops server; here the
+        # engine contributes what only it owns: the watchdog heartbeat
+        # gauge (S3: a wedged collective visible from outside the process)
+        # and the flight recorder behind POST /debug/dump.
+        if self.telemetry is not None and self.telemetry.registry is not None:
+            if self.watchdog is not None:
+                self.telemetry.registry.gauge(
+                    "watchdog_heartbeat_age_s",
+                    fn=self.watchdog.heartbeat_age_s)
+            srv = self.telemetry.obs_server
+            if srv is not None:
+                if self.watchdog is not None:
+                    from deepspeed_tpu.telemetry import watchdog_health_check
+                    srv.add_health_check(
+                        "watchdog", watchdog_health_check(self.watchdog))
+                if self.flight_recorder is not None:
+                    srv.flight_recorder = self.flight_recorder
 
         # progressive layer drop
         self.progressive_layer_drop = None
@@ -2198,6 +2222,7 @@ class DeepSpeedEngine:
                     grad_norm=stats.get("grad_norm"),
                     loss_scale=stats.get("loss_scale"),
                     global_samples=self.global_samples)
+                self.telemetry.maybe_snapshot(self.global_steps)
             if self.profiler_window is not None:
                 self.profiler_window.step_end(self.global_steps)
             self._report_progress()
@@ -2548,6 +2573,10 @@ class DeepSpeedEngine:
                 except Exception as e:
                     logger.warning(f"comms summary emission failed: {e}")
             self.telemetry.close()
+            if self.telemetry.registry is not None:
+                from deepspeed_tpu.comm import comm as comm_backend
+                if comm_backend._METRICS_REGISTRY is self.telemetry.registry:
+                    comm_backend.configure_metrics_registry(None)
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.tracer is not None:

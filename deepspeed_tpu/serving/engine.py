@@ -144,6 +144,24 @@ class ServingEngine:
         self._config = cfg
         self.telemetry = telemetry
         self.tracer = tracer
+        # live metrics plane: gauges + step-time histograms are updated
+        # directly (host wall-clock / scheduler counts, zero device syncs);
+        # event-derived metrics (TTFT, preemptions, restages) flow through
+        # the hub's MetricsSink on the periodic flush below — one source
+        # of truth per metric, no double counting.
+        self.registry = getattr(telemetry, "registry", None)
+        if self.registry is not None:
+            r = self.registry
+            self._g_queue = r.gauge("serve_queue_depth")
+            self._g_active = r.gauge("serve_active")
+            self._g_blocks = r.gauge("serve_blocks_in_use")
+            self._g_blocks_total = r.gauge("serve_blocks_total")
+            self._g_blocks_total.set(cfg.num_blocks)
+            self._g_host_bytes = r.gauge("serve_kv_host_bytes")
+            self._g_nvme_bytes = r.gauge("serve_kv_nvme_bytes")
+            self._g_prefix_rate = r.gauge("prefix_hit_rate")
+            self._h_step = r.histogram("serve_step_ms")
+            self._h_decode = r.histogram("serve_decode_step_ms")
         self.dtype = cfg.jnp_dtype
         assert hasattr(model, "paged_step") and hasattr(model, "cfg"), (
             "ServingEngine needs a model with .cfg and .paged_step(...) "
@@ -214,6 +232,10 @@ class ServingEngine:
         self.tokens_generated = 0
         self._started = time.monotonic()
         self._closed = False
+        self._owns_telemetry = False    # init_serving flips for dict-built hubs
+        obs = getattr(telemetry, "obs_server", None)
+        if obs is not None:
+            obs.add_health_check("serve_arena", self._arena_health)
         log_dist(
             f"ServingEngine ready: slots={cfg.max_batch_size}, "
             f"arena={cfg.num_blocks}x{cfg.block_size} tok "
@@ -229,6 +251,24 @@ class ServingEngine:
     def _emit(self, kind, payload, step=None):
         if self.telemetry is not None:
             self.telemetry.emit(kind, payload, step=step)
+
+    def _arena_health(self):
+        """`/healthz` contribution: arena + tier occupancy (always ``ok``
+        on its own — oversubscription is a designed-for state; the gauges
+        give the operator the occupancy picture)."""
+        st = self.sched.stats()
+        total = int(self._config.num_blocks)
+        used = int(st.get("blocks_in_use", 0))
+        out = {"ok": True, "blocks_in_use": used, "blocks_total": total,
+               "occupancy": round(used / total, 4) if total else 0.0,
+               "active": int(st.get("active", 0)),
+               "queue_depth": int(st.get("queue_depth", 0))}
+        if self.tiering is not None:
+            ts = self.tiering.stats()
+            for key in ("kv_host_bytes", "kv_nvme_bytes"):
+                if key in ts:
+                    out[key] = ts[key]
+        return out
 
     def _on_preempt(self, victim: Request):
         self._emit("serve_preempt", {
@@ -295,6 +335,7 @@ class ServingEngine:
         step over every decode-ready sequence.  Returns the step stats."""
         self.sched.admit()
         prefill_tokens = 0
+        t_step = time.monotonic() if self.registry is not None else 0.0
         with self._span("serve.step", step=self.step_count):
             pf = self.sched.next_prefill()
             if pf is not None:
@@ -313,8 +354,11 @@ class ServingEngine:
                     self.sched.ensure_capacity(r, r.prefilled + 1)
             decode = self.sched.decode_batch()
             if decode:
+                t_dec = time.monotonic() if self.registry is not None else 0.0
                 with self._span("serve.decode", batch=len(decode)):
                     self._run_decode(decode)
+                if self.registry is not None:
+                    self._h_decode.observe((time.monotonic() - t_dec) * 1e3)
         self.step_count += 1
         stats = dict(self.sched.stats(), decode_batch=len(decode),
                      prefill_tokens=prefill_tokens,
@@ -324,9 +368,29 @@ class ServingEngine:
             stats.update(self.tiering.stats())
         if self.prefix is not None:
             stats.update(self.prefix.stats())
+        if self.registry is not None:
+            self._h_step.observe((time.monotonic() - t_step) * 1e3)
+            for gauge, key in ((self._g_queue, "queue_depth"),
+                               (self._g_active, "active"),
+                               (self._g_blocks, "blocks_in_use"),
+                               (self._g_host_bytes, "kv_host_bytes"),
+                               (self._g_nvme_bytes, "kv_nvme_bytes")):
+                v = stats.get(key)
+                if isinstance(v, (int, float)):
+                    gauge.set(v)
+            lookups = stats.get("prefix_lookups")
+            if lookups:
+                self._g_prefix_rate.set(
+                    int(stats.get("prefix_hits", 0)) / int(lookups))
         if (self.telemetry is not None and self._config.telemetry_every
                 and self.step_count % self._config.telemetry_every == 0):
             self._emit("serve_step", stats, step=self.step_count)
+            if self.registry is not None:
+                # drain the emit buffer so event-derived metrics (TTFT,
+                # restage, preemption) stay live for /metrics scrapes,
+                # then run the pod fold at its own cadence
+                self.telemetry.flush()
+                self.telemetry.maybe_snapshot(self.step_count)
         return stats
 
     def run(self, max_steps: int = 1_000_000) -> int:
@@ -347,6 +411,11 @@ class ServingEngine:
         self._closed = True
         if self.tiering is not None:
             self.tiering.close()
+        if self._owns_telemetry and self.telemetry is not None:
+            try:
+                self.telemetry.close()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------ #
     def _run_prefill(self, req: Request, start: int, n: int):
@@ -434,11 +503,17 @@ def init_serving(model=None, config=None, **kwargs):
     telemetry = cfg_dict.pop("telemetry", None)
     tracer = cfg_dict.pop("tracer", None)
     seed = cfg_dict.pop("model_seed", None)
+    owns_telemetry = False
     if isinstance(telemetry, dict):
         from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
         from deepspeed_tpu.telemetry import TelemetryHub
         tcfg = DeepSpeedTelemetryConfig(**telemetry)
         telemetry = TelemetryHub.from_config(tcfg) if tcfg.enabled else None
+        owns_telemetry = telemetry is not None
     cfg = DeepSpeedServingConfig(**cfg_dict)
-    return ServingEngine(model, config=cfg, params=params, seed=seed,
-                         telemetry=telemetry, tracer=tracer)
+    eng = ServingEngine(model, config=cfg, params=params, seed=seed,
+                        telemetry=telemetry, tracer=tracer)
+    # a hub built here from a config dict has no other owner: the engine
+    # closes it (final flush + ops-server shutdown) on close()
+    eng._owns_telemetry = owns_telemetry
+    return eng

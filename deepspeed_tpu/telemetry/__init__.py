@@ -1,21 +1,32 @@
 """deepspeed_tpu.telemetry — structured step events, JSONL sink, windowed
-XLA profiler capture, span tracing, and the hang-watchdog flight recorder.
-See README.md § Telemetry / § Tracing for config keys and schemas."""
+XLA profiler capture, span tracing, the hang-watchdog flight recorder, and
+the live observability plane (metrics registry, ops HTTP endpoints, SLO
+burn-rate monitors).  See README.md § Observability for config keys,
+schemas, and the scrape contract."""
 
-from deepspeed_tpu.telemetry import events
+from deepspeed_tpu.telemetry import events, stats
 from deepspeed_tpu.telemetry.events import (SCHEMA_VERSION,
                                             STEP_REQUIRED_FIELDS, make_record)
 from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder, read_dump
 from deepspeed_tpu.telemetry.hub import (JsonlSink, MonitorSink,
                                          RingBufferSink, TelemetryHub,
                                          TelemetrySink)
+from deepspeed_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
+                                             MetricsRegistry, MetricsSink,
+                                             cross_rank_snapshot,
+                                             merge_snapshots,
+                                             render_prometheus)
+from deepspeed_tpu.telemetry.obs_server import ObsServer, watchdog_health_check
 from deepspeed_tpu.telemetry.profiler import ProfilerWindow
+from deepspeed_tpu.telemetry.slo import (SLOMonitor, SLORule, default_rules,
+                                         rules_from_config)
 from deepspeed_tpu.telemetry.tracing import (Tracer, get_global_tracer,
                                              maybe_span, set_global_tracer)
 from deepspeed_tpu.telemetry.watchdog import HangWatchdog
 
 __all__ = [
     "events",
+    "stats",
     "SCHEMA_VERSION",
     "STEP_REQUIRED_FIELDS",
     "make_record",
@@ -32,4 +43,18 @@ __all__ = [
     "HangWatchdog",
     "FlightRecorder",
     "read_dump",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsSink",
+    "merge_snapshots",
+    "cross_rank_snapshot",
+    "render_prometheus",
+    "ObsServer",
+    "watchdog_health_check",
+    "SLORule",
+    "SLOMonitor",
+    "default_rules",
+    "rules_from_config",
 ]

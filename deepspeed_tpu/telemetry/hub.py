@@ -65,11 +65,20 @@ class TelemetrySink:
 
 class JsonlSink(TelemetrySink):
     """Append-only JSONL file, rank-0 only.  The first line of a fresh file
-    is a ``schema`` header record so the file is self-describing."""
+    is a ``schema`` header record so the file is self-describing.
 
-    def __init__(self, path: str, rank: int = 0):
+    Size-capped rotation (``max_bytes`` > 0): when the live file passes the
+    cap it is renamed to ``path.N`` (N ascending = chronological) and a
+    fresh header-bearing file is opened; at most ``keep`` rotated files are
+    retained.  Readers go through ``telemetry.stats.load_records``, which
+    walks the rotated set transparently."""
+
+    def __init__(self, path: str, rank: int = 0, max_bytes: int = 0,
+                 keep: int = 5):
         self.path = path
         self.rank = rank
+        self.max_bytes = int(max_bytes or 0)
+        self.keep = max(1, int(keep))
         self._fh = None
 
     def _ensure_open(self):
@@ -92,6 +101,29 @@ class JsonlSink(TelemetrySink):
         for rec in records:
             self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
+        if self.max_bytes and self._fh.tell() >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self):
+        from deepspeed_tpu.telemetry import stats as _stats
+        self._fh.close()
+        self._fh = None
+        rotated = [p for p in _stats.rotated_set(self.path)
+                   if p != self.path and os.path.exists(p)]
+        next_idx = 1
+        if rotated:
+            next_idx = max(int(p.rsplit(".", 1)[1]) for p in rotated) + 1
+        try:
+            os.replace(self.path, f"{self.path}.{next_idx}")
+        except OSError as e:
+            logger.warning(f"telemetry jsonl rotation failed: {e}")
+            return
+        rotated.append(f"{self.path}.{next_idx}")
+        for stale in rotated[:max(0, len(rotated) - self.keep)]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
 
     def close(self):
         if self._fh is not None:
@@ -177,6 +209,14 @@ class TelemetryHub:
         self._window_t = time.time()     # wall clock of the last drained step
         self._window_comm = 0            # cumulative comm bytes at last record
         self.closed = False
+        # live observability plane (wired up by from_config when enabled)
+        self.registry = None             # metrics.MetricsRegistry
+        self.slo_monitor = None          # slo.SLOMonitor, run at flush boundary
+        self.obs_server = None           # obs_server.ObsServer
+        self.snapshot_every = 0          # cross-rank fold cadence (steps)
+        self._last_snapshot_step = None
+        self._last_step_mono = None
+        self._last_flush_mono = time.monotonic()
 
     # -- construction ---------------------------------------------------- #
     @classmethod
@@ -189,7 +229,10 @@ class TelemetryHub:
         rank = jax.process_index()
         sinks: List[TelemetrySink] = []
         if tcfg.jsonl_path:
-            sinks.append(JsonlSink(tcfg.jsonl_path, rank=rank))
+            sinks.append(JsonlSink(
+                tcfg.jsonl_path, rank=rank,
+                max_bytes=getattr(tcfg, "jsonl_max_bytes", 0),
+                keep=getattr(tcfg, "jsonl_keep", 5)))
         if tcfg.ring_buffer_size:
             sinks.append(RingBufferSink(tcfg.ring_buffer_size))
         if monitor is not None:
@@ -198,9 +241,29 @@ class TelemetryHub:
         flops_fn = None
         if flops_profiler is not None:
             flops_fn = lambda: flops_profiler.flops_per_step  # noqa: E731
-        return cls(sinks=sinks, flush_every=flush_every, batch_size=batch_size,
-                   device_count=jax.device_count(), comms_logger=comms_logger,
-                   flops_per_step=flops_fn)
+        hub = cls(sinks=sinks, flush_every=flush_every, batch_size=batch_size,
+                  device_count=jax.device_count(), comms_logger=comms_logger,
+                  flops_per_step=flops_fn)
+        if getattr(tcfg, "metrics", True):
+            from deepspeed_tpu.telemetry import slo as slo_mod
+            from deepspeed_tpu.telemetry.metrics import (MetricsRegistry,
+                                                         MetricsSink)
+            hub.registry = MetricsRegistry()
+            hub.add_sink(MetricsSink(hub.registry))
+            hub.snapshot_every = int(getattr(tcfg, "snapshot_every", 0) or 0)
+            hub.slo_monitor = slo_mod.SLOMonitor(
+                slo_mod.rules_from_config(getattr(tcfg, "slo_rules", None)),
+                registry=hub.registry, telemetry=hub)
+            if getattr(tcfg, "ops_server", False):
+                from deepspeed_tpu.telemetry.obs_server import ObsServer
+                hub.obs_server = ObsServer(
+                    hub.registry,
+                    host=getattr(tcfg, "ops_host", "127.0.0.1"),
+                    port=getattr(tcfg, "ops_port", 0),
+                    slo_monitor=hub.slo_monitor)
+                hub.obs_server.add_health_check("telemetry", hub.health_check)
+                hub.obs_server.start()
+        return hub
 
     # -- sink queries (tests) -------------------------------------------- #
     def add_sink(self, sink: TelemetrySink):
@@ -228,6 +291,7 @@ class TelemetryHub:
         here blocks on the device."""
         if self.closed:
             return
+        self._last_step_mono = time.monotonic()
         # dslint: ok(zero-sync) — step is the host-side counter, never traced
         rec: Dict[str, Any] = {"step": int(step), "_t": time.time()}
         cbytes, cops = self._comm_totals()
@@ -322,11 +386,54 @@ class TelemetryHub:
                 sink.write(out)
             except Exception as e:
                 logger.warning(f"telemetry sink {type(sink).__name__} failed: {e}")
+        self._last_flush_mono = time.monotonic()
+        if self.slo_monitor is not None:
+            try:
+                self.slo_monitor.evaluate()
+            except Exception as e:
+                logger.warning(f"SLO evaluation failed: {e}")
+
+    # -- live observability plane ----------------------------------------- #
+    def maybe_snapshot(self, step: int):
+        """Run the cross-rank metrics fold at the configured step cadence
+        (``telemetry.snapshot_every``); rank 0's registry then carries the
+        pod-level merged snapshot the ops server serves under
+        ``dstpu_pod_``."""
+        if not (self.registry is not None and self.snapshot_every):
+            return
+        last = self._last_snapshot_step
+        if last is not None and step - last < self.snapshot_every:
+            return
+        self._last_snapshot_step = step
+        from deepspeed_tpu.telemetry import metrics as metrics_mod
+        try:
+            metrics_mod.cross_rank_snapshot(self.registry)
+        except Exception as e:
+            logger.warning(f"cross-rank metrics snapshot failed: {e}")
+
+    def health_check(self) -> Dict[str, Any]:
+        """`/healthz` contribution: last-step / last-flush ages.  Always
+        ``ok`` on its own (step cadence is workload-defined) — the
+        watchdog check is what flips unhealthy on a stall."""
+        now = time.monotonic()
+        age = None
+        if self._last_step_mono is not None:
+            age = round(now - self._last_step_mono, 3)
+        return {"ok": True, "last_step_age_s": age,
+                "last_flush_age_s": round(now - self._last_flush_mono, 3),
+                "pending_records": len(self._pending)}
 
     def close(self):
         if self.closed:
             return
         self.flush()
+        if self._pending:        # SLO transition events from the final flush
+            self.flush()
+        if self.obs_server is not None:
+            try:
+                self.obs_server.stop()
+            except Exception:
+                pass
         for sink in self.sinks:
             try:
                 sink.close()

@@ -84,6 +84,19 @@ class HangWatchdog:
         with self._lock:
             self._armed = False
 
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return self._armed
+
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the last beat — the ``watchdog_heartbeat_age_s``
+        gauge behind ``/healthz``: readable by the obs-server scrape
+        thread without perturbing the beat itself."""
+        with self._lock:
+            last = self._last_beat_ns
+        return max(0.0, (self._clock() - last) / 1e9)
+
     # -- stall detection ------------------------------------------------ #
     def check(self, now_ns: Optional[int] = None) -> bool:
         """Evaluate the stall condition once; returns True iff this call

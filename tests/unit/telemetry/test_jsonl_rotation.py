@@ -1,0 +1,97 @@
+"""JsonlSink size-capped rotation and the transparent rotated-set read
+path: the sink rolls ``telemetry.jsonl`` to ``.1``, ``.2``, … with a
+keep-N cap, and ``stats.load_records`` (hence every report CLI) folds
+the whole set back in chronological order."""
+
+import json
+import os
+
+from deepspeed_tpu.telemetry import stats
+from deepspeed_tpu.telemetry.hub import JsonlSink
+
+
+def _write_steps(sink, start, n):
+    for s in range(start, start + n):
+        sink.write([{"kind": "step", "step": s, "step_time_ms": 10.0,
+                     "pad": "x" * 64}])
+
+
+class TestRotation:
+    def test_rotation_creates_chronological_set(self, tmp_path):
+        path = str(tmp_path / "telemetry.jsonl")
+        sink = JsonlSink(path, max_bytes=512, keep=10)
+        _write_steps(sink, 0, 40)
+        sink.close()
+        rotated = [p for p in stats.rotated_set(path) if p != path]
+        assert len(rotated) >= 2            # the cap actually rolled files
+        assert all(os.path.exists(p) for p in rotated)
+        # ascending rotation index = chronological order
+        idx = [int(p.rsplit(".", 1)[1]) for p in rotated]
+        assert idx == sorted(idx)
+        # live file last in the read order
+        assert stats.rotated_set(path)[-1] == path
+
+    def test_load_records_reads_whole_set_in_order(self, tmp_path):
+        path = str(tmp_path / "telemetry.jsonl")
+        sink = JsonlSink(path, max_bytes=512, keep=100)
+        _write_steps(sink, 0, 50)
+        sink.close()
+        records, err = stats.load_records(path)
+        assert err is None
+        steps = [r["step"] for r in records if r["kind"] == "step"]
+        assert steps == list(range(50))     # nothing lost, order preserved
+
+    def test_keep_n_prunes_oldest(self, tmp_path):
+        path = str(tmp_path / "telemetry.jsonl")
+        sink = JsonlSink(path, max_bytes=256, keep=2)
+        _write_steps(sink, 0, 60)
+        sink.close()
+        rotated = [p for p in stats.rotated_set(path) if p != path]
+        assert len(rotated) <= 2
+        # pruning drops the OLDEST rotations: the surviving set's steps
+        # are a contiguous tail ending at the live file's last step
+        records, err = stats.load_records(path)
+        assert err is None
+        steps = [r["step"] for r in records if r["kind"] == "step"]
+        assert steps == sorted(steps)
+        assert steps[-1] == 59
+        assert steps[0] > 0                 # head was pruned
+
+    def test_no_cap_means_no_rotation(self, tmp_path):
+        path = str(tmp_path / "telemetry.jsonl")
+        sink = JsonlSink(path, max_bytes=0)
+        _write_steps(sink, 0, 40)
+        sink.close()
+        assert stats.rotated_set(path) == [path]
+
+    def test_report_cli_reads_rotated_set(self, tmp_path):
+        """End-to-end through a report tool: stability_report folds the
+        full rotated set, not just the live file."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "stability_report", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "..", "..", "..", "tools", "stability_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        path = str(tmp_path / "telemetry.jsonl")
+        sink = JsonlSink(path, max_bytes=512, keep=100)
+        _write_steps(sink, 0, 30)
+        sink.write([{"kind": "anomaly", "step": 30, "cause": "loss_spike"}])
+        _write_steps(sink, 31, 30)
+        sink.close()
+        assert len(stats.rotated_set(path)) > 1
+        records, err = mod.load_records(path)
+        assert err is None
+        report = mod.fold(records)
+        assert report["steps"] == 60        # both rotations + live folded
+        assert report["anomalies"] == 1
+
+    def test_unrelated_suffixes_ignored(self, tmp_path):
+        path = str(tmp_path / "telemetry.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "step", "step": 0}) + "\n")
+        (tmp_path / "telemetry.jsonl.bak").write_text("junk")
+        (tmp_path / "telemetry.jsonl.1x").write_text("junk")
+        assert stats.rotated_set(path) == [path]

@@ -1,0 +1,281 @@
+"""Live metrics plane: registry semantics, thread-safety under a
+concurrent scraper, histogram merge associativity, the shared stats
+primitives, the drain-path MetricsSink mapping, and the Prometheus
+text contract."""
+
+import json
+import threading
+
+import pytest
+
+from deepspeed_tpu.telemetry import stats
+from deepspeed_tpu.telemetry.metrics import (Histogram, MetricsRegistry,
+                                             MetricsSink, merge_snapshots,
+                                             render_prometheus, replay_jsonl)
+
+
+class TestStatsPrimitives:
+    def test_percentile_matches_report_cli_convention(self):
+        # byte-identical to the _pct every report CLI used before the
+        # factor-out: sorted_vals[min(len-1, int(q*len))]
+        vals = [1.0, 2.0, 3.0, 4.0]
+        assert stats.percentile(vals, 0.50) == vals[2]
+        assert stats.percentile(vals, 0.99) == vals[3]
+        assert stats.percentile([7.0], 0.99) == 7.0
+        assert stats.percentile([], 0.5) is None
+
+    def test_bucket_index_boundaries(self):
+        bounds = (10.0, 100.0)
+        assert stats.bucket_index(bounds, 5.0) == 0
+        assert stats.bucket_index(bounds, 10.0) == 0    # le semantics
+        assert stats.bucket_index(bounds, 10.5) == 1
+        assert stats.bucket_index(bounds, 1e9) == 2     # overflow bucket
+
+    def test_quantile_from_buckets(self):
+        bounds = (10.0, 100.0, 1000.0)
+        counts = [90, 9, 1, 0]
+        assert stats.quantile_from_buckets(bounds, counts, 0.5) == 10.0
+        assert stats.quantile_from_buckets(bounds, counts, 0.95) == 100.0
+        assert stats.quantile_from_buckets(bounds, [0, 0, 0, 0], 0.5) is None
+
+    def test_merge_bucket_counts_rejects_shape_mismatch(self):
+        with pytest.raises(ValueError):
+            stats.merge_bucket_counts([1, 2], [1, 2, 3])
+
+
+class TestRegistry:
+    def test_get_or_create_is_stable(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("x_total", {"op": "a"})
+        c1.inc(3)
+        assert reg.counter("x_total", {"op": "a"}) is c1
+        assert reg.counter("x_total", {"op": "b"}) is not c1
+        assert c1.value == 3.0
+
+    def test_gauge_callable_sampled_at_snapshot(self):
+        reg = MetricsRegistry()
+        box = {"v": 1.5}
+        reg.gauge("age_s", fn=lambda: box["v"])
+        assert reg.snapshot()["gauges"]["age_s"]["value"] == 1.5
+        box["v"] = 9.0
+        assert reg.snapshot()["gauges"]["age_s"]["value"] == 9.0
+
+    def test_histogram_quantiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", bounds=(1.0, 10.0, 100.0))
+        for _ in range(98):
+            h.observe(5.0)
+        h.observe(50.0)
+        h.observe(50.0)
+        assert h.quantile(0.5) == 10.0
+        assert h.quantile(0.99) == 100.0
+        assert h.count == 100
+
+    def test_threaded_writers_vs_scraper(self):
+        """Registry stays consistent while writer threads race a scraper:
+        final counts are exact, and every mid-flight snapshot/render is
+        well-formed."""
+        reg = MetricsRegistry()
+        n_threads, n_iter = 8, 300
+        stop = threading.Event()
+        scrape_errors = []
+
+        def writer(tid):
+            c = reg.counter("w_total", {"t": str(tid % 2)})
+            h = reg.histogram("w_ms", bounds=(1.0, 10.0))
+            g = reg.gauge("w_gauge")
+            for i in range(n_iter):
+                c.inc()
+                h.observe(float(i % 20))
+                g.set(float(i))
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    snap = reg.snapshot()
+                    render_prometheus(snap)
+                    for ent in snap["histograms"].values():
+                        total = sum(ent["counts"])
+                        assert ent["count"] == total
+                except Exception as e:    # noqa: BLE001 — collected below
+                    scrape_errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        s = threading.Thread(target=scraper)
+        s.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        s.join()
+        assert not scrape_errors
+        snap = reg.snapshot()
+        by_t = {k: v["value"] for k, v in snap["counters"].items()}
+        assert sum(by_t.values()) == n_threads * n_iter
+        hist = snap["histograms"]["w_ms"]
+        assert hist["count"] == n_threads * n_iter
+        assert sum(hist["counts"]) == n_threads * n_iter
+
+
+class TestMerge:
+    def _snap(self, reg_fill):
+        reg = MetricsRegistry()
+        reg_fill(reg)
+        return reg.snapshot()
+
+    def _fill(self, c, g, observations):
+        def fill(reg):
+            reg.counter("c_total").inc(c)
+            reg.gauge("g").set(g)
+            h = reg.histogram("h_ms", bounds=(1.0, 10.0, 100.0))
+            for v in observations:
+                h.observe(v)
+        return fill
+
+    def test_histogram_merge_is_associative(self):
+        a = self._snap(self._fill(1, 1.0, [0.5, 5.0]))
+        b = self._snap(self._fill(2, 2.0, [50.0]))
+        c = self._snap(self._fill(3, 3.0, [500.0, 5.0, 0.1]))
+        ha, hb, hc = (s["histograms"]["h_ms"]["counts"] for s in (a, b, c))
+        left = stats.merge_bucket_counts(stats.merge_bucket_counts(ha, hb),
+                                         hc)
+        right = stats.merge_bucket_counts(ha,
+                                          stats.merge_bucket_counts(hb, hc))
+        flat = merge_snapshots([a, b, c])
+        assert left == right == flat["histograms"]["h_ms"]["counts"]
+        assert flat["counters"]["c_total"]["value"] == 6.0
+        g = flat["gauges"]["g"]
+        assert (g["min"], g["max"], g["mean"]) == (1.0, 3.0, 2.0)
+        assert flat["histograms"]["h_ms"]["count"] == 6
+
+    def test_merge_rejects_bounds_mismatch(self):
+        a = self._snap(self._fill(1, 1.0, [1.0]))
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(1)
+        reg.gauge("g").set(1.0)
+        reg.histogram("h_ms", bounds=(5.0, 50.0)).observe(1.0)
+        with pytest.raises(ValueError):
+            merge_snapshots([a, reg.snapshot()])
+
+
+class TestMetricsSink:
+    def test_step_and_offload_records(self):
+        reg = MetricsRegistry()
+        records = [
+            {"kind": "step", "step": 1, "step_time_ms": 12.5, "loss": 2.0,
+             "lr": 1e-3, "comm_bytes": 100},
+            {"kind": "step", "step": 2, "step_time_ms": 7.5, "loss": 1.5,
+             "lr": 1e-3, "comm_bytes": 100},
+            {"kind": "offload_staged", "step": 2, "ring_hits": 3,
+             "ring_misses": 1, "wait_ms": 4.0,
+             "nvme_bytes_written": 1024, "nvme_bytes_read": 2048,
+             "nvme_ring_hits": 3, "nvme_wait_ms": 4.0},
+            {"kind": "offload_wait", "step": 2, "wait_ms": 4.0},
+            {"kind": "anomaly", "step": 2, "cause": "loss_spike"},
+        ]
+        snap = replay_jsonl(reg, records).snapshot()
+        assert snap["counters"]["train_steps_total"]["value"] == 2.0
+        assert snap["histograms"]["train_step_time_ms"]["count"] == 2
+        assert snap["histograms"]["train_step_time_ms"]["sum"] == 20.0
+        assert snap["gauges"]["train_loss"]["value"] == 1.5
+        key = 'offload_bytes_written_total{store="nvme"}'
+        assert snap["counters"][key]["value"] == 1024.0
+        assert snap["counters"]["offload_stall_ms_total"]["value"] == 4.0
+        assert snap["gauges"]["offload_ring_hit_rate"]["value"] == 0.75
+        assert snap["counters"]["stability_anomalies_total"]["value"] == 1.0
+
+    def test_serving_records(self):
+        reg = MetricsRegistry()
+        records = [
+            {"kind": "serve_request", "event": "submitted"},
+            {"kind": "serve_request", "event": "finished", "ttft_ms": 80.0,
+             "latency_ms": 200.0, "new_tokens": 16},
+            {"kind": "serve_step", "step": 4, "queue_depth": 2, "active": 1,
+             "blocks_in_use": 8, "kv_host_bytes": 512, "kv_nvme_bytes": 0,
+             "elapsed_ms": 1000.0, "prefix_lookups": 4, "prefix_hits": 2},
+            {"kind": "serve_preempt", "request_id": 1},
+            {"kind": "kv_spill", "tier": "host", "bytes": 256},
+            {"kind": "kv_restage", "wait_ms": 3.0, "bytes": 256},
+            {"kind": "prefix_hit", "blocks": 2},
+        ]
+        snap = replay_jsonl(reg, records).snapshot()
+        assert snap["histograms"]["serve_ttft_ms"]["count"] == 1
+        assert snap["histograms"]["serve_ttft_ms"]["sum"] == 80.0
+        assert snap["gauges"]["serve_queue_depth"]["value"] == 2.0
+        assert snap["gauges"]["serve_blocks_in_use"]["value"] == 8.0
+        assert snap["gauges"]["serve_kv_host_bytes"]["value"] == 512.0
+        assert snap["counters"]["serve_preemptions_total"]["value"] == 1.0
+        assert snap["counters"]['kv_spill_bytes_total{tier="host"}'][
+            "value"] == 256.0
+        assert snap["counters"]["prefix_hits_total"]["value"] == 1.0
+
+    def test_comm_summary_is_cumulative_not_double_counted(self):
+        reg = MetricsRegistry()
+        summary = {"kind": "comm_summary",
+                   "ops": {"all_gather": {"total_bytes": 4096, "count": 8,
+                                          "compression_ratio": 4.0,
+                                          "buckets": []}},
+                   "total_bytes": 4096, "total_logical_bytes": 16384,
+                   "total_ops": 8}
+        replay_jsonl(reg, [summary, dict(summary)])    # emitted twice
+        snap = reg.snapshot()
+        key = 'comm_total_bytes{op="all_gather"}'
+        assert snap["gauges"][key]["value"] == 4096.0    # gauge: no 2x
+        assert snap["gauges"]['comm_compression_ratio{op="all_gather"}'][
+            "value"] == 4.0
+
+    def test_unknown_kinds_ignored(self):
+        # the sink pre-registers its metric set at construction; unknown
+        # record kinds must leave every one of them at zero
+        reg = MetricsRegistry()
+        replay_jsonl(reg, [{"kind": "mystery", "x": 1}, {"no_kind": True}])
+        snap = reg.snapshot()
+        assert all(c["value"] == 0.0 for c in snap["counters"].values())
+        assert all(h["count"] == 0 for h in snap["histograms"].values())
+
+
+class TestPrometheusText:
+    def test_golden_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("req_total", {"op": "a"}).inc(3)
+        reg.gauge("depth").set(2.0)
+        h = reg.histogram("lat_ms", bounds=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(500.0)
+        text = render_prometheus(reg.snapshot())
+        expected = [
+            "# TYPE dstpu_depth gauge",
+            "dstpu_depth 2",
+            "# TYPE dstpu_lat_ms histogram",
+            'dstpu_lat_ms_bucket{le="1.0"} 1',
+            'dstpu_lat_ms_bucket{le="10.0"} 2',
+            'dstpu_lat_ms_bucket{le="+Inf"} 3',
+            "dstpu_lat_ms_sum 505.5",
+            "dstpu_lat_ms_count 3",
+            "# TYPE dstpu_req_total counter",
+            'dstpu_req_total{op="a"} 3',
+        ]
+        lines = text.splitlines()
+        for want in expected:
+            assert want in lines, (want, text)
+
+    def test_merged_snapshot_renders_agg_labels(self):
+        reg1, reg2 = MetricsRegistry(), MetricsRegistry()
+        reg1.gauge("g").set(1.0)
+        reg2.gauge("g").set(3.0)
+        merged = merge_snapshots([reg1.snapshot(), reg2.snapshot()])
+        text = render_prometheus(merged, prefix="dstpu_pod_", merged=True)
+        assert 'dstpu_pod_g{agg="min"} 1' in text
+        assert 'dstpu_pod_g{agg="max"} 3' in text
+        assert 'dstpu_pod_g{agg="mean"} 2' in text
+
+    def test_snapshot_json_round_trip(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(2)
+        reg.histogram("h_ms", bounds=(1.0,)).observe(0.5)
+        snap = reg.snapshot()
+        assert json.loads(json.dumps(snap)) == snap

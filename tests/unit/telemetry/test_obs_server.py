@@ -1,0 +1,153 @@
+"""Ops HTTP endpoint contract: /metrics Prometheus text, the /healthz
+state machine (watchdog heartbeat age vs arm threshold), /slo verdicts,
+and the /debug/dump flight-recorder round-trip — all against a real
+loopback ThreadingHTTPServer on an ephemeral port."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder, read_dump
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.telemetry.obs_server import (ObsServer,
+                                                watchdog_health_check)
+from deepspeed_tpu.telemetry.slo import SLOMonitor, SLORule
+from deepspeed_tpu.telemetry.watchdog import HangWatchdog
+
+
+def _get(url, timeout=5):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+@pytest.fixture()
+def server():
+    reg = MetricsRegistry()
+    srv = ObsServer(reg, port=0).start()
+    yield reg, srv
+    srv.stop()
+
+
+class TestEndpoints:
+    def test_metrics_exposition(self, server):
+        reg, srv = server
+        reg.counter("req_total").inc(2)
+        reg.histogram("lat_ms", bounds=(10.0,)).observe(3.0)
+        code, body, headers = _get(f"{srv.url}/metrics")
+        assert code == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "dstpu_req_total 2" in body
+        assert 'dstpu_lat_ms_bucket{le="10.0"} 1' in body
+        assert "dstpu_lat_ms_count 1" in body
+
+    def test_metrics_includes_pod_view_after_snapshot(self, server):
+        from deepspeed_tpu.telemetry.metrics import cross_rank_snapshot
+        reg, srv = server
+        reg.gauge("g").set(4.0)
+        cross_rank_snapshot(reg)
+        _, body, _ = _get(f"{srv.url}/metrics")
+        assert 'dstpu_pod_g{agg="mean"} 4' in body
+
+    def test_unknown_route_404(self, server):
+        _, srv = server
+        code, _, _ = _get(f"{srv.url}/nope")
+        assert code == 404
+
+    def test_slo_endpoint(self, server):
+        reg, srv = server
+        rule = SLORule("lat_p99", "lat_ms", "p99", 100.0, min_samples=1,
+                       fast_burn=1.0, slow_burn=1.0)
+        clock = {"t": 0.0}
+        srv.slo_monitor = SLOMonitor([rule], registry=reg,
+                                     clock=lambda: clock["t"])
+        h = reg.histogram("lat_ms", bounds=(10.0, 1000.0))
+        h.observe(5.0)
+        clock["t"] += 1.0
+        srv.slo_monitor.evaluate()
+        code, body, _ = _get(f"{srv.url}/slo")
+        assert code == 200 and json.loads(body)["ok"]
+        for _ in range(3):
+            h.observe(900.0)
+            clock["t"] += 1.0
+            srv.slo_monitor.evaluate()
+        code, body, _ = _get(f"{srv.url}/slo")
+        assert code == 503
+        assert "lat_p99" in json.loads(body)["burning"]
+
+    def test_slo_404_when_no_monitor(self, server):
+        _, srv = server
+        code, _, _ = _get(f"{srv.url}/slo")
+        assert code == 404
+
+    def test_debug_dump_round_trip(self, server, tmp_path):
+        reg, srv = server
+        srv.flight_recorder = FlightRecorder(str(tmp_path))
+        code, body, _ = _get(f"{srv.url}/debug/dump")
+        assert code == 200
+        out = json.loads(body)
+        assert out["ok"]
+        dump = read_dump(out["path"])
+        assert dump["header"][0]["reason"] == "ops_debug_dump"
+
+    def test_debug_dump_500_without_recorder(self, server):
+        _, srv = server
+        code, _, _ = _get(f"{srv.url}/debug/dump")
+        assert code == 500
+
+
+class TestHealthz:
+    def test_healthy_then_stalled_then_recovered(self, server):
+        """The /healthz state machine against a fake-clock watchdog:
+        healthy while beating, 503 once the heartbeat age crosses the
+        arm threshold, healthy again after a beat, and armed-ness
+        gates the whole check (a disarmed watchdog can't be stale)."""
+        reg, srv = server
+        clock = {"ns": 0}
+        wd = HangWatchdog(timeout_s=10.0, clock=lambda: clock["ns"])
+        srv.add_health_check("watchdog", watchdog_health_check(wd))
+
+        code, body, _ = _get(f"{srv.url}/healthz")
+        out = json.loads(body)
+        assert code == 200 and out["healthy"]
+        assert out["checks"]["watchdog"]["armed"] is False
+
+        wd.arm("train_step")
+        clock["ns"] = int(11e9)                 # age 11s > threshold 10s
+        code, body, _ = _get(f"{srv.url}/healthz")
+        out = json.loads(body)
+        assert code == 503 and not out["healthy"]
+        assert out["checks"]["watchdog"]["heartbeat_age_s"] > 10.0
+
+        wd.pet()                                # beat: age back to 0
+        code, body, _ = _get(f"{srv.url}/healthz")
+        assert code == 200 and json.loads(body)["healthy"]
+
+        clock["ns"] += int(11e9)
+        wd.disarm()                             # disarmed: stale age ok
+        code, _, _ = _get(f"{srv.url}/healthz")
+        assert code == 200
+
+    def test_raising_check_reports_unhealthy(self, server):
+        _, srv = server
+        srv.add_health_check("boom", lambda: 1 / 0)
+        code, body, _ = _get(f"{srv.url}/healthz")
+        out = json.loads(body)
+        assert code == 503
+        assert out["checks"]["boom"]["ok"] is False
+        assert "error" in out["checks"]["boom"]
+
+    def test_heartbeat_age_gauge_shape(self):
+        clock = {"ns": int(5e9)}
+        wd = HangWatchdog(timeout_s=10.0, clock=lambda: clock["ns"])
+        clock["ns"] += int(3e9)
+        assert wd.heartbeat_age_s() == pytest.approx(3.0)
+        reg = MetricsRegistry()
+        reg.gauge("watchdog_heartbeat_age_s", fn=wd.heartbeat_age_s)
+        snap = reg.snapshot()
+        assert snap["gauges"]["watchdog_heartbeat_age_s"][
+            "value"] == pytest.approx(3.0)

@@ -1,0 +1,187 @@
+"""SLO burn-rate state machine: rule grammar validation, the
+ok → burn_fast/burn_slow transitions with slo_burn/slo_clear event
+emission, the forced-p99-TTFT acceptance case, and silence on a clean
+run — all under an injected clock, no sleeping."""
+
+import pytest
+
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.telemetry.slo import (SLOMonitor, SLORule, default_rules,
+                                         rules_from_config)
+
+
+class _Hub:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, payload):
+        self.events.append((kind, dict(payload)))
+
+
+def _monitor(rules, reg, hub=None):
+    clock = {"t": 0.0}
+    mon = SLOMonitor(rules, registry=reg, telemetry=hub,
+                     clock=lambda: clock["t"])
+    return mon, clock
+
+
+class TestRuleGrammar:
+    def test_from_dict_round_trip(self):
+        d = {"name": "r", "metric": "serve_ttft_ms", "op": "p99",
+             "bound": 500.0, "budget_frac": 0.1, "min_samples": 2}
+        r = SLORule.from_dict(d)
+        assert r.to_dict()["bound"] == 500.0
+        assert SLORule.from_dict(r.to_dict()).name == "r"
+
+    def test_rejects_unknown_keys_and_bad_ops(self):
+        with pytest.raises((ValueError, TypeError)):
+            SLORule.from_dict({"name": "r", "metric": "m", "op": "p99",
+                               "bound": 1.0, "mystery": 1})
+        with pytest.raises(ValueError):
+            SLORule("r", "m", "p42", 1.0)
+        with pytest.raises(ValueError):
+            SLORule("r", "m", "ratio", 1.0)       # ratio needs den
+        with pytest.raises(ValueError):
+            SLORule("r", "m", "value", 1.0, budget_frac=0.0)
+
+    def test_default_rules_and_config(self):
+        names = {r.name for r in default_rules()}
+        assert names == {"serve_p99_ttft_ms", "offload_stall_frac",
+                         "step_time_regression"}
+        assert {r.name for r in rules_from_config([])} == names
+        only = rules_from_config([{"name": "x", "metric": "m",
+                                   "op": "value", "bound": 1.0}])
+        assert [r.name for r in only] == ["x"]
+
+    def test_duplicate_rule_names_rejected(self):
+        r = SLORule("dup", "m", "value", 1.0)
+        with pytest.raises(ValueError):
+            SLOMonitor([r, SLORule("dup", "m", "value", 2.0)])
+
+
+class TestBurnStateMachine:
+    def test_clean_run_stays_silent(self):
+        """Values under the bound: state ok forever, zero events."""
+        reg = MetricsRegistry()
+        hub = _Hub()
+        rules = default_rules(serve_p99_ttft_ms=2000.0)
+        mon, clock = _monitor(rules, reg, hub)
+        h = reg.histogram("serve_ttft_ms", bounds=(100.0, 1000.0, 5000.0))
+        for _ in range(20):
+            h.observe(50.0)
+            clock["t"] += 1.0
+            v = mon.evaluate()
+        assert v["ok"] and v["burning"] == [] and v["burn_events"] == 0
+        assert hub.events == []
+        assert not v["rules"]["serve_p99_ttft_ms"]["violated"]
+
+    def test_forced_p99_ttft_fires_fast_burn_then_clears(self):
+        """p99 TTFT forced over budget → burn_fast + slo_burn event;
+        sustained clean samples age the violations out → slo_clear."""
+        reg = MetricsRegistry()
+        hub = _Hub()
+        rule = SLORule("serve_p99_ttft_ms", "serve_ttft_ms", "p99", 1000.0,
+                       budget_frac=0.05, fast_window_s=60.0,
+                       slow_window_s=600.0, fast_burn=10.0, slow_burn=2.0,
+                       min_samples=3)
+        mon, clock = _monitor([rule], reg, hub)
+        h = reg.histogram("serve_ttft_ms", bounds=(100.0, 1000.0, 10000.0))
+        for _ in range(4):
+            h.observe(5000.0)               # every observation over budget
+            clock["t"] += 1.0
+            v = mon.evaluate()
+        assert v["rules"]["serve_p99_ttft_ms"]["state"] == "burn_fast"
+        assert "serve_p99_ttft_ms" in v["burning"]
+        assert v["burn_events"] == 1        # one transition, not per-sample
+        kinds = [k for k, _ in hub.events]
+        assert kinds == ["slo_burn"]
+        assert hub.events[0][1]["severity"] == "fast"
+        assert hub.events[0][1]["value"] > 1000.0
+
+        # the histogram is cumulative, so p99 stays violated until enough
+        # clean mass lands; flood it clean and advance past the window
+        for _ in range(1000):
+            h.observe(50.0)
+        clock["t"] += 700.0                 # all violating samples age out
+        v = mon.evaluate()
+        assert v["rules"]["serve_p99_ttft_ms"]["state"] == "ok"
+        assert [k for k, _ in hub.events] == ["slo_burn", "slo_clear"]
+
+    def test_slow_burn_without_fast(self):
+        """A violation rate over the slow budget but under the fast
+        threshold lands in burn_slow, not burn_fast."""
+        reg = MetricsRegistry()
+        rule = SLORule("g_high", "gauge:g", "value", 10.0,
+                       budget_frac=0.5, fast_window_s=10.0,
+                       slow_window_s=1000.0, fast_burn=2.0, slow_burn=1.0,
+                       min_samples=3)
+        mon, clock = _monitor([rule], reg)
+        g = reg.gauge("g")
+        # 6 violating samples spread far apart: outside the fast window
+        # they thin to <2x fast burn, but the slow window holds them all
+        pattern = [20.0, 1.0, 20.0, 1.0, 20.0, 20.0, 1.0, 20.0, 1.0]
+        for val in pattern:
+            g.set(val)
+            clock["t"] += 20.0              # 20s apart: fast window sees 1
+            v = mon.evaluate()
+        st = v["rules"]["g_high"]
+        assert st["state"] == "burn_slow"
+        assert st["burn_slow"] >= 1.0
+        assert st["samples_fast"] < 3       # fast path starved of samples
+
+    def test_min_samples_gates_alerting(self):
+        reg = MetricsRegistry()
+        rule = SLORule("g_high", "gauge:g", "value", 1.0, min_samples=5,
+                       budget_frac=0.01, fast_burn=1.0, slow_burn=1.0)
+        mon, clock = _monitor([rule], reg)
+        g = reg.gauge("g")
+        for _ in range(4):                  # violating, but below min
+            g.set(100.0)
+            clock["t"] += 1.0
+            v = mon.evaluate()
+        assert v["rules"]["g_high"]["state"] == "ok"
+        assert v["rules"]["g_high"]["violated"]
+
+    def test_missing_metric_never_violates(self):
+        reg = MetricsRegistry()
+        mon, clock = _monitor(default_rules(), reg)
+        for _ in range(5):
+            clock["t"] += 1.0
+            v = mon.evaluate()
+        assert v["ok"] and v["burn_events"] == 0
+        assert v["rules"]["serve_p99_ttft_ms"]["value"] is None
+
+    def test_ratio_rule(self):
+        reg = MetricsRegistry()
+        rule = SLORule("stall", "counter:offload_stall_ms_total", "ratio",
+                       0.15, den="sum:train_step_time_ms", min_samples=1,
+                       budget_frac=0.05, fast_burn=1.0)
+        mon, clock = _monitor([rule], reg)
+        reg.counter("offload_stall_ms_total").inc(50.0)
+        reg.histogram("train_step_time_ms", bounds=(10.0,)).observe(100.0)
+        clock["t"] += 1.0
+        v = mon.evaluate()
+        st = v["rules"]["stall"]
+        assert st["value"] == pytest.approx(0.5)
+        assert st["violated"] and st["state"] == "burn_fast"
+
+    def test_regression_rule_baselines_then_detects(self):
+        reg = MetricsRegistry()
+        rule = SLORule("step_reg", "train_step_time_ms", "regression", 1.5,
+                       baseline_min_count=10, min_samples=1, budget_frac=0.05,
+                       fast_burn=1.0)
+        mon, clock = _monitor([rule], reg)
+        h = reg.histogram("train_step_time_ms",
+                          bounds=(10.0, 20.0, 50.0, 100.0))
+        for _ in range(10):
+            h.observe(9.0)                  # p50 = 10.0 → baseline
+        clock["t"] += 1.0
+        v = mon.evaluate()
+        assert v["rules"]["step_reg"]["value"] is None    # baseline capture
+        for _ in range(200):
+            h.observe(45.0)                 # p50 jumps to 50.0 = 5x
+        clock["t"] += 1.0
+        v = mon.evaluate()
+        st = v["rules"]["step_reg"]
+        assert st["value"] == pytest.approx(5.0)
+        assert st["violated"] and st["state"] == "burn_fast"

@@ -1,0 +1,102 @@
+"""tools/obs_report.py: offline SLO-verdict CLI — exit-code contract
+(0 clean / 1 violated-or-burning / 2 usage), the synthetic-clock burn
+replay, and the custom --rule grammar."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_TOOL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", "tools", "obs_report.py")
+
+
+@pytest.fixture(scope="module")
+def obs_report():
+    spec = importlib.util.spec_from_file_location("obs_report", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, records, name="t.jsonl"):
+    p = tmp_path / name
+    with open(p, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(p)
+
+
+def _serve_run(ttft_ms, n=10):
+    recs = []
+    for i in range(n):
+        recs.append({"kind": "serve_request", "event": "finished", "step": i,
+                     "ttft_ms": ttft_ms, "latency_ms": ttft_ms + 50.0,
+                     "new_tokens": 8})
+        recs.append({"kind": "serve_step", "step": i,
+                     "elapsed_ms": (i + 1) * 100.0, "queue_depth": 1,
+                     "active": 1, "blocks_in_use": 4})
+    return recs
+
+
+class TestVerdictCLI:
+    def test_clean_run_exits_0_and_is_silent(self, obs_report, tmp_path,
+                                             capsys):
+        path = _write(tmp_path, _serve_run(ttft_ms=40.0))
+        rc = obs_report.main([path])
+        rep = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert rep["ok"] and rep["violated"] == []
+        assert rep["verdict"]["burn_events"] == 0
+
+    def test_forced_p99_over_budget_exits_1_with_burn(self, obs_report,
+                                                      tmp_path, capsys):
+        path = _write(tmp_path, _serve_run(ttft_ms=5000.0))
+        rc = obs_report.main([path])
+        rep = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert "serve_p99_ttft_ms" in rep["violated"]
+        assert rep["verdict"]["burn_events"] > 0
+        assert rep["verdict"]["rules"]["serve_p99_ttft_ms"][
+            "state"] == "burn_fast"
+
+    def test_bound_is_configurable(self, obs_report, tmp_path, capsys):
+        path = _write(tmp_path, _serve_run(ttft_ms=5000.0))
+        rc = obs_report.main([path, "--p99-ttft-ms", "60000"])
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_custom_rule_grammar(self, obs_report, tmp_path, capsys):
+        recs = [{"kind": "serve_step", "step": i, "elapsed_ms": (i + 1) * 100,
+                 "queue_depth": 50, "active": 1, "blocks_in_use": 4}
+                for i in range(6)]
+        path = _write(tmp_path, recs)
+        rule = json.dumps({"name": "queue_bound",
+                           "metric": "gauge:serve_queue_depth",
+                           "op": "value", "bound": 10.0, "min_samples": 1,
+                           "fast_burn": 1.0})
+        rc = obs_report.main([path, "--no-default-rules", "--rule", rule])
+        rep = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert rep["violated"] == ["queue_bound"]
+
+    def test_usage_errors_exit_2(self, obs_report, tmp_path, capsys):
+        assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 2
+        path = _write(tmp_path, _serve_run(40.0))
+        assert obs_report.main([path, "--rule", "{broken"]) == 2
+        assert obs_report.main([path, "--no-default-rules"]) == 2
+        capsys.readouterr()
+
+    def test_json_out_and_training_clock(self, obs_report, tmp_path, capsys):
+        recs = [{"kind": "step", "step": s, "step_time_ms": 100.0,
+                 "loss": 1.0, "lr": 1e-3} for s in range(8)]
+        path = _write(tmp_path, recs)
+        out = str(tmp_path / "report.json")
+        rc = obs_report.main([path, "--json", out])
+        capsys.readouterr()
+        assert rc == 0
+        rep = json.load(open(out))
+        # one evaluation per step boundary plus the end-of-run sample
+        assert rep["evaluations"] == 9
+        assert rep["records"] == 8

@@ -120,6 +120,27 @@ class TestZeroSyncPass:
                if not ctx.sanctioned(sf, ln, "zero-sync")]
         assert out == []
 
+    def test_metrics_hot_path_scopes_are_guarded(self):
+        """The live metrics plane's inc/set/observe and the SLO
+        monitor's evaluate are in the checked-scope roster."""
+        scopes = set(zero_sync.CHECKED_SCOPES)
+        for scope in ("inc", "set", "observe"):
+            assert ("deepspeed_tpu/telemetry/metrics.py", scope) in scopes
+        assert ("deepspeed_tpu/telemetry/slo.py", "evaluate") in scopes
+
+    def test_seeded_sync_in_metrics_hot_path_is_flagged(self, tmp_path):
+        """A seeded violation in a registry-style observe() — somebody
+        handing a device value straight to a histogram — is caught."""
+        sf, _ = _scan(tmp_path, (
+            "class Histogram:\n"
+            "    def observe(self, value):\n"
+            "        v = float(value)\n"
+            "        self._sum += v.item()\n"))
+        msgs = [m for _, m in zero_sync.scope_violations(sf, "observe")]
+        assert len(msgs) == 2
+        assert any("float()" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+
 
 class TestLockDisciplinePass:
     FIXTURE = (

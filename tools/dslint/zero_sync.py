@@ -55,6 +55,13 @@ CHECKED_SCOPES: Sequence[Tuple[str, Optional[str]]] = (
     ("deepspeed_tpu/runtime/engine.py", "_build_fused_step"),
     ("deepspeed_tpu/runtime/engine.py", "_value_and_grad"),
     ("deepspeed_tpu/runtime/engine.py", "_device_view"),
+    # live metrics plane hot path: callers hand inc/set/observe host
+    # scalars; nothing inside may force a device value.  The SLO
+    # monitor's evaluate() reads registry snapshots (already host-side).
+    ("deepspeed_tpu/telemetry/metrics.py", "inc"),
+    ("deepspeed_tpu/telemetry/metrics.py", "set"),
+    ("deepspeed_tpu/telemetry/metrics.py", "observe"),
+    ("deepspeed_tpu/telemetry/slo.py", "evaluate"),
 )
 
 _NUMPY_MODULES = ("np", "numpy")

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Offline SLO verdict over a telemetry JSONL set.
+
+Replays the records of a finished run through the same
+:class:`~deepspeed_tpu.telemetry.metrics.MetricsSink` and
+:class:`~deepspeed_tpu.telemetry.slo.SLOMonitor` that power the live
+observability plane, driving the monitor's burn-rate windows with a
+synthetic clock rebuilt from the run's own wall-time records (``step``
+``step_time_ms`` for training, ``serve_step`` ``elapsed_ms`` for
+serving).  The registry view is bit-identical to what the live sink
+would have accumulated, so the verdict printed here matches what the
+ops server's ``/slo`` endpoint would have reported at the end of the
+run.  Same family as ``tools/serve_report.py`` / ``offload_audit.py``:
+forensics over run artifacts, no jax required.
+
+Usage::
+
+    python tools/obs_report.py TELEMETRY_JSONL
+        [--p99-ttft-ms X] [--max-stall-frac X] [--step-time-factor X]
+        [--rule JSON]... [--no-default-rules] [--json OUT]
+
+``--rule`` takes a JSON object in the ``telemetry.slo_rules`` grammar
+(see README § Observability) and may repeat; explicit rules replace the
+stock defaults unless combined with the default knobs.  Reads the full
+rotated JSONL set (``telemetry.jsonl.1``, ``.2``, … then the live
+file).
+
+Exit 0 when every rule ends the replay clean (no violation, no burn
+event fired at any point); 1 when a rule is violated at end of run or a
+fast/slow burn alert fired mid-replay; 2 on usage errors (unreadable
+file, malformed ``--rule`` JSON).
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(name):
+    """Load a telemetry module by file path so the tool keeps its no-jax
+    property; package import is the fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", name + ".py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_" + name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    import importlib
+    return importlib.import_module("deepspeed_tpu.telemetry." + name)
+
+
+_stats = _load("stats")
+_metrics = _load("metrics")
+_slo = _load("slo")
+
+load_records = _stats.load_records
+
+
+def replay(records, rules):
+    """Feed records through a MetricsSink under a synthetic clock,
+    evaluating the SLO monitor at every wall-time boundary the run
+    recorded.  → (monitor, evaluations)."""
+    registry = _metrics.MetricsRegistry()
+    sink = _metrics.MetricsSink(registry)
+    clock = {"t": 0.0}
+    monitor = _slo.SLOMonitor(rules, registry=registry,
+                              clock=lambda: clock["t"])
+    evaluations = 0
+    batch = []
+    for rec in records:
+        batch.append(rec)
+        kind = rec.get("kind")
+        boundary = False
+        if kind == "step":
+            try:
+                clock["t"] += float(rec.get("step_time_ms", 0.0)) / 1e3
+            except (TypeError, ValueError):
+                pass
+            boundary = True
+        elif kind == "serve_step":
+            try:
+                elapsed = float(rec.get("elapsed_ms", 0.0)) / 1e3
+            except (TypeError, ValueError):
+                elapsed = 0.0
+            clock["t"] = max(clock["t"], elapsed)
+            boundary = True
+        if boundary:
+            sink.write(batch)
+            batch = []
+            monitor.evaluate()
+            evaluations += 1
+    if batch:
+        sink.write(batch)
+    # a file with no wall-time records still gets one end-of-run sample
+    clock["t"] += 1.0
+    monitor.evaluate()
+    return monitor, evaluations + 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Replay telemetry JSONL through the SLO monitor")
+    ap.add_argument("path", help="telemetry JSONL file (rotated set ok)")
+    ap.add_argument("--p99-ttft-ms", type=float, default=2000.0,
+                    help="serve_p99_ttft_ms default-rule bound")
+    ap.add_argument("--max-stall-frac", type=float, default=0.15,
+                    help="offload_stall_frac default-rule bound")
+    ap.add_argument("--step-time-factor", type=float, default=1.5,
+                    help="step_time_regression default-rule factor")
+    ap.add_argument("--rule", action="append", default=[],
+                    help="extra SLO rule as JSON (telemetry.slo_rules "
+                         "grammar); repeatable")
+    ap.add_argument("--no-default-rules", action="store_true",
+                    help="evaluate only --rule entries")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    records, err = load_records(args.path)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    rules = []
+    if not args.no_default_rules:
+        rules.extend(_slo.default_rules(
+            serve_p99_ttft_ms=args.p99_ttft_ms,
+            offload_stall_frac=args.max_stall_frac,
+            step_time_factor=args.step_time_factor))
+    for spec in args.rule:
+        try:
+            rules.append(_slo.SLORule.from_dict(json.loads(spec)))
+        except (ValueError, TypeError, KeyError) as e:
+            print(json.dumps({"error": f"bad --rule {spec!r}: {e}"}),
+                  file=sys.stderr)
+            return 2
+    if not rules:
+        print(json.dumps({"error": "no SLO rules to evaluate"}),
+              file=sys.stderr)
+        return 2
+
+    monitor, evaluations = replay(records, rules)
+    verdict = monitor.verdict()
+    violated = sorted(n for n, r in verdict["rules"].items()
+                      if r.get("violated"))
+    report = {
+        "path": args.path,
+        "records": len(records),
+        "evaluations": evaluations,
+        "violated": violated,
+        "verdict": verdict,
+    }
+    report["ok"] = (verdict["ok"] and verdict["burn_events"] == 0
+                    and not violated)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,30 +36,41 @@ import os
 import sys
 
 
+def _load_stats():
+    """Shared JSONL-set loader (telemetry/stats.py), loaded by file path
+    so the tool keeps its no-jax property; package import is the
+    fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", "stats.py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_stats", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    from deepspeed_tpu.telemetry import stats
+    return stats
+
+
+_stats = _load_stats()
+
+
 def load_records(path: str):
-    """→ (offload_staged records, step_time_ms by step, error or None)."""
-    if not os.path.isfile(path):
-        return None, None, f"{path}: not a file"
+    """→ (offload_staged records, step_time_ms by step, error or None).
+
+    Reads the full rotated JSONL set via the shared loader, then keeps
+    the two kinds this audit folds."""
+    records, err = _stats.load_records(path)
+    if err:
+        return None, None, err
     staged, step_ms = [], {}
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue     # torn tail line from a crashed run
-                if not isinstance(rec, dict):
-                    continue
-                kind = rec.get("kind")
-                if kind == "offload_staged":
-                    staged.append(rec)
-                elif kind == "step" and "step_time_ms" in rec:
-                    step_ms[int(rec.get("step", -1))] = float(rec["step_time_ms"])
-    except OSError as e:
-        return None, None, f"unreadable {path}: {e}"
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "offload_staged":
+            staged.append(rec)
+        elif kind == "step" and "step_time_ms" in rec:
+            step_ms[int(rec.get("step", -1))] = float(rec["step_time_ms"])
     if not staged:
         return None, None, (f"{path}: no offload_staged records (was the run "
                             "started with offload_param/offload_optimizer?)")

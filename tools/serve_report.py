@@ -38,35 +38,33 @@ import os
 import sys
 
 
-def load_records(path: str):
-    """→ (records list, error string or None).  Tolerates torn tail lines
-    (a crashed run) but rejects files with no parseable telemetry records."""
-    if not os.path.isfile(path):
-        return None, f"{path}: not a file"
-    records = []
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue     # torn tail line from a crashed run
-                if isinstance(rec, dict) and "kind" in rec:
-                    records.append(rec)
-    except OSError as e:
-        return None, f"unreadable {path}: {e}"
-    if not records:
-        return None, f"{path}: no telemetry records (wrong file?)"
-    return records, None
+def _load_stats():
+    """Shared percentile/JSONL-set helpers (telemetry/stats.py).
+
+    Loaded by file path so the tool keeps its no-jax property: importing
+    the ``deepspeed_tpu.telemetry`` package would drag in the full jax
+    dependency chain.  Falls back to the package import for installed
+    layouts where the sibling path does not exist."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", "stats.py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_stats", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    from deepspeed_tpu.telemetry import stats
+    return stats
 
 
-def _pct(sorted_vals, q):
-    if not sorted_vals:
-        return None
-    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+_stats = _load_stats()
+
+# Reads the full rotated JSONL set (telemetry.jsonl.1, .2, … then the
+# live file); behavior-identical to the old local loader on un-rotated
+# files.  Kept as module-level names — tests and bench import these.
+load_records = _stats.load_records
+_pct = _stats.percentile
 
 
 def fold(records):

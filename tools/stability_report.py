@@ -37,30 +37,29 @@ TIMELINE_KINDS = ("anomaly", "lr_backoff", "auto_rollback",
                   "batch_quarantined", "ef_reset")
 
 
-def load_records(path: str):
-    """→ (records list, error string or None).  Tolerates torn tail lines
-    (a crashed run) but rejects files with no parseable telemetry records
-    at all — those are not telemetry JSONL."""
-    if not os.path.isfile(path):
-        return None, f"{path}: not a file"
-    records = []
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue     # torn tail line from a crashed run
-                if isinstance(rec, dict) and "kind" in rec:
-                    records.append(rec)
-    except OSError as e:
-        return None, f"unreadable {path}: {e}"
-    if not records:
-        return None, f"{path}: no telemetry records (wrong file?)"
-    return records, None
+def _load_stats():
+    """Shared JSONL-set loader (telemetry/stats.py), loaded by file path
+    so the tool keeps its no-jax property; package import is the
+    fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", "stats.py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_stats", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    from deepspeed_tpu.telemetry import stats
+    return stats
+
+
+_stats = _load_stats()
+
+# Reads the full rotated JSONL set (telemetry.jsonl.1, .2, … then the
+# live file); behavior-identical to the old local loader on un-rotated
+# files.
+load_records = _stats.load_records
 
 
 def fold(records):
