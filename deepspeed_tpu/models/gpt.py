@@ -677,6 +677,17 @@ def gpt_forward(cfg: GPTConfig, params: Dict, input_ids: Array,
     return (logits, aux_total) if with_aux else logits
 
 
+def _pallas_ce_wanted(N: int, E: int, V: int) -> bool:
+    """Route the loss through the fused Pallas CE kernel when enabled
+    (``DST_PALLAS_CE``) and the shape/mesh is supported; any failure here
+    means the XLA chunked path below — never an error."""
+    try:
+        from deepspeed_tpu.ops.pallas import cross_entropy as _pce
+        return _pce.pallas_ce_enabled() and _pce.ce_supported(N, E, V)
+    except Exception:
+        return False
+
+
 def chunked_cross_entropy(x: Array, head: Array, labels: Array,
                           vocab_size: int, n_chunks: int = 0,
                           head_b: Optional[Array] = None) -> Array:
@@ -695,6 +706,11 @@ def chunked_cross_entropy(x: Array, head: Array, labels: Array,
     B, S, E = x.shape
     V = head.shape[0]
     N = B * S
+    if _pallas_ce_wanted(N, E, V):
+        from deepspeed_tpu.ops.pallas import cross_entropy as _pce
+        return _pce.fused_cross_entropy(x.reshape(N, E), head,
+                                        labels.reshape(N), vocab_size,
+                                        head_b=head_b)
     if n_chunks <= 0:
         # chunking trades ~1/3 extra head FLOPs (backward recompute) for
         # the [N, V] memory.  Measured on v5e (r5): chunking LOSES while the
@@ -769,9 +785,10 @@ def gpt_loss(cfg: GPTConfig, params: Dict, input_ids: Array, labels: Array,
                          pld_theta=pld_theta, return_hidden=True,
                          with_aux=True)
     head = params["lm_head"] if cfg.untied_head else params["wte"]
-    ce = chunked_cross_entropy(x, head, labels, cfg.vocab_size,
-                               head_b=params.get("lm_head_b")
-                               if cfg.head_bias else None)
+    with jax.named_scope("cross_entropy"):
+        ce = chunked_cross_entropy(x, head, labels, cfg.vocab_size,
+                                   head_b=params.get("lm_head_b")
+                                   if cfg.head_bias else None)
     if cfg.moe_num_experts > 0:
         # load-balance aux loss (reference l_aux, sharded_moe.py:179)
         ce = ce + cfg.moe_aux_coeff * aux

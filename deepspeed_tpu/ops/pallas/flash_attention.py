@@ -87,15 +87,48 @@ def _interpret() -> bool:
         return True
 
 
+#: (q_shape, reason-class) combos already warned about — the demotion is
+#: per-call, the telemetry warning one-shot so a training loop doesn't
+#: log once per step
+_FALLBACK_WARNED = set()
+
+
+def _fallback_warn_once(shape, reason: str) -> None:
+    key = (tuple(shape), reason.split(":")[0])
+    if key in _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED.add(key)
+    from deepspeed_tpu.utils.logging import logger
+    logger.warning("flash_attention %s: %s — demoting to reference "
+                   "attention (further occurrences silenced)", tuple(shape),
+                   reason)
+
+
 def _block_sizes(S: int, bq: Optional[int], bk: Optional[int]):
     """Default blocks: largest divisor of S up to 256 (q) / 512 (k) —
     measured on v5e (r5): (256, 512) beats (128, 128) ~2.3x end-to-end at
     S=512 (fewer online-softmax rescales, larger MXU tiles) and also wins
-    at S=1024 over (256, 1024)."""
-    bq = bq or next(b for b in (256, 128, 64, 32, 16, 8, 4, 2, 1) if S % b == 0)
-    bk = bk or next(b for b in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1) if S % b == 0)
-    assert S % bq == 0 and S % bk == 0, f"seq {S} not divisible by blocks {bq}/{bk}"
-    return bq, bk
+    at S=1024 over (256, 1024).
+
+    Requested sizes (user/env) are CLAMPED to the largest divisor of S at
+    most the request — never asserted on — so an odd S degrades to a
+    smaller block or to the reference fallback instead of crashing.  For
+    S below the cap this yields the full-S block, which is always a legal
+    Mosaic tile (the round-1 ``(1, 1, 128)`` cliff came from divisor
+    hunting down to sub-sublane blocks like bq=1 at small prime S)."""
+    def fit(req: Optional[int], cap: int) -> int:
+        b = min(req or cap, cap, S)
+        while S % b:
+            b -= 1
+        return b
+    return fit(bq, 256), fit(bk, 512)
+
+
+def _blocks_lowerable(S: int, bq: int, bk: int) -> bool:
+    """Mosaic tiling: a block's second-to-last dim must be a sublane
+    multiple (8 for fp32) or span the full extent.  The last dim is the
+    head extent D, which is always the full dim, so only bq/bk gate."""
+    return all(b == S or b % 8 == 0 for b in (bq, bk))
 
 
 def _bias_spec_qrows(bias, bq, S):
@@ -449,7 +482,12 @@ def flash_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None,
     block_k = block_k or int(os.environ.get("DST_FLASH_BK", "0")) or None
     B, S, H, D = q.shape
     Hkv = k.shape[2]
-    if S % min(128, S) != 0 or H % Hkv != 0:
+    block_q, block_k = _block_sizes(S, block_q, block_k)
+    if not _blocks_lowerable(S, block_q, block_k) or H % Hkv != 0:
+        # e.g. S=1000: largest divisor ≤256 is 250 — neither a sublane
+        # multiple nor full-S, so the tile can't lower; take the jnp path
+        _fallback_warn_once(q.shape, f"blocks ({block_q},{block_k}) for "
+                            f"S={S} are not lowerable")
         from deepspeed_tpu.ops.attention import reference_attention
         return reference_attention(q, k, v, causal=causal, bias=bias, alibi=alibi)
     scale = 1.0 / np.sqrt(D)
@@ -494,4 +532,11 @@ def flash_attention(q, k, v, *, causal: bool = True, bias=None, alibi=None,
             from deepspeed_tpu.parallel.mesh import shard_map
             return shard_map(inner, mesh=mesh, in_specs=tuple(in_specs),
                              out_specs=spec, check_vma=False)(*args)
-    return _flash_bshd(q, k, v, bias, slopes, causal, scale, block_q, block_k)
+    try:
+        return _flash_bshd(q, k, v, bias, slopes, causal, scale,
+                           block_q, block_k)
+    except Exception as e:  # Mosaic lowering failure → demote, don't wedge
+        _fallback_warn_once(q.shape, f"kernel lowering failed: {e}")
+        from deepspeed_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, bias=bias,
+                                   alibi=alibi)

@@ -1,0 +1,232 @@
+"""Pallas TPU fused Adam/AdamW update (training step hot path).
+
+The unfused step is an optax chain traced per leaf: XLA emits separate
+moment-update, bias-correction, decay and axpy loops, each re-reading the
+leaf from HBM.  This kernel does the whole update for one leaf block —
+param, grad, m, v in, param/m/v out — in a single VMEM pass with the
+loss-scale unscale and the clip factor folded in as SMEM scalars, which
+is what lets the offload-chunked walk in ``runtime/engine.py`` update
+chunk N while chunk N+1's NVMe swap-in is still in flight (the per-leaf
+launch has no dependency on the rest of the tree).
+
+Parity contract (``tests/unit/runtime/test_fused_optim.py``): bitwise
+equality with the optax chain in fp32 — the kernel performs the exact
+optax 0.2.x op sequence (``(1-b)*g + b*m``, safe int32 count increment,
+``m/bc1 / (sqrt(n/bc2) + eps)``, decay-after for AdamW, ``-lr`` scale)
+with the same scalar promotion, so there is no tolerance to tune.
+
+Supported chains: ``optax.adamw`` (static lr or schedule) and
+``optax.adam`` — i.e. the factory's adam/fusedadam/cpuadam/adamw with
+``adam_w_mode`` (the default).  Anything else (``add_decayed_weights``
+*before* adam = L2 mode, lamb, onebit, client chains) makes
+:func:`match_adam_chain` return ``None`` and the engine keeps the optax
+path.  Env: ``DST_PALLAS_FUSED_OPT`` — ``1`` forces (interpret mode on
+CPU), ``0`` disables, unset enables on TPU.
+"""
+
+import functools
+import os
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# jax < 0.5 spells these ``TPUCompilerParams`` / ``TPUMemorySpace``.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+_MEMSPACE = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
+_LANE = 128
+_SUBLANE = 8
+_INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _interpret() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
+def fused_opt_enabled() -> bool:
+    """Tri-state ``DST_PALLAS_FUSED_OPT``: forced on/off, else on-if-TPU."""
+    flag = os.environ.get("DST_PALLAS_FUSED_OPT", "").strip().lower()
+    if flag in ("0", "off", "false"):
+        return False
+    if flag in ("1", "on", "true"):
+        return True
+    return not _interpret()
+
+
+# --------------------------------------------------------------------------- #
+# Spec + state-shape matching
+# --------------------------------------------------------------------------- #
+def spec_from_config(name: str, params: Dict[str, Any],
+                     lr: Union[float, Callable[[int], float]]
+                     ) -> Optional[Dict[str, Any]]:
+    """Fusion spec for a ds_config optimizer block, or ``None`` when the
+    resulting optax chain isn't a decay-after Adam (the only math this
+    kernel implements)."""
+    name = (name or "adam").lower()
+    if name not in ("adam", "adamw", "fusedadam", "cpuadam"):
+        return None
+    adam_w = bool(params.get("adam_w_mode", True)) or name == "adamw"
+    wd = float(params.get("weight_decay", 0.0))
+    if not adam_w and wd:
+        return None      # L2 mode: decay feeds the moments; different math
+    betas = params.get("betas", (0.9, 0.999))
+    return {"b1": float(betas[0]), "b2": float(betas[1]),
+            "eps": float(params.get("eps", 1e-8)),
+            "wd": wd if adam_w else 0.0, "lr": lr}
+
+
+def match_adam_chain(opt_state) -> Optional[Tuple[int, Optional[int]]]:
+    """``(adam_idx, schedule_idx)`` into the chain's state tuple, or
+    ``None`` when the structure isn't optax adam/adamw: exactly one
+    ScaleByAdamState, at most one ScaleByScheduleState, all other links
+    stateless."""
+    if not isinstance(opt_state, tuple) or isinstance(opt_state, jnp.ndarray):
+        return None
+    adam_idx = sched_idx = None
+    for i, s in enumerate(opt_state):
+        fields = getattr(s, "_fields", None)
+        if fields is None:
+            return None
+        if "mu" in fields and "nu" in fields and "count" in fields:
+            if adam_idx is not None:
+                return None
+            adam_idx = i
+        elif "count" in fields:
+            if sched_idx is not None:
+                return None
+            sched_idx = i
+        elif len(fields):
+            return None
+    if adam_idx is None:
+        return None
+    return adam_idx, sched_idx
+
+
+def _safe_int32_increment(count):
+    # optax.safe_int32_increment — saturates instead of wrapping
+    return jnp.where(count < _INT32_MAX, count + 1, _INT32_MAX)
+
+
+def step_scalars(spec: Dict[str, Any], count, sched_count=None):
+    """(neg_lr, bc1, bc2) for this step, matching optax's promotion: the
+    bias corrections are ``1 - b**count_inc`` in f32, the step size is
+    ``-1 * lr(count)`` (schedule) or the static ``-lr``."""
+    count_inc = _safe_int32_increment(count)
+    bc1 = (1.0 - spec["b1"] ** count_inc).astype(jnp.float32)
+    bc2 = (1.0 - spec["b2"] ** count_inc).astype(jnp.float32)
+    lr = spec["lr"]
+    if callable(lr):
+        sc = count if sched_count is None else sched_count
+        neg_lr = jnp.asarray(-1 * lr(sc), jnp.float32)
+    else:
+        neg_lr = jnp.asarray(-lr, jnp.float32)
+    return neg_lr, bc1, bc2
+
+
+# --------------------------------------------------------------------------- #
+# Kernel: one [rows, 128] leaf block per grid step.  scal (SMEM) =
+# [inv, clip_factor, neg_lr, bc1, bc2]; inv/clip fold the loss-scale
+# unscale and the grad clip so raw accumulated grads can feed the kernel
+# with the exact ``(g*inv)*factor`` op order of the unfused path.
+# --------------------------------------------------------------------------- #
+def _adam_kernel(scal_ref, p_ref, g_ref, mu_ref, nu_ref,
+                 op_ref, omu_ref, onu_ref, *, b1, b2, eps, wd):
+    g = (g_ref[...].astype(jnp.float32) * scal_ref[0]) * scal_ref[1]
+    mu = (1 - b1) * g + b1 * mu_ref[...]
+    nu = (1 - b2) * (g * g) + b2 * nu_ref[...]
+    u = (mu / scal_ref[3]) / (jnp.sqrt(nu / scal_ref[4]) + eps)
+    if wd:
+        u = u + wd * p_ref[...]
+    u = scal_ref[2] * u
+    p = p_ref[...]
+    op_ref[...] = (p + u).astype(op_ref.dtype)
+    omu_ref[...] = mu
+    onu_ref[...] = nu
+
+
+def _row_block(rows: int) -> int:
+    for br in (2048, 1024, 512, 256, 128, 64, 32, 16, 8):
+        if rows % br == 0:
+            return br
+    return rows
+
+
+def fused_leaf_update(p, g, mu, nu, scal, *, b1, b2, eps, wd):
+    """(new_p, new_mu, new_nu) for one leaf.  ``scal`` is the stacked
+    [inv, clip_factor, neg_lr, bc1, bc2] f32 vector; shapes are free —
+    the leaf is flattened and padded to (rows, 128) lane tiles (the pad
+    region computes zeros and is sliced off)."""
+    shape, pdt = p.shape, p.dtype
+    n = int(p.size)
+    tile = _LANE * _SUBLANE
+    n_pad = (-n) % tile
+    def flat(a, dt=None):
+        a = a.reshape(-1) if a.shape != () else a.reshape(1)
+        a = a.astype(dt) if dt is not None else a
+        if n_pad:
+            a = jnp.concatenate([a, jnp.zeros((n_pad,), a.dtype)])
+        return a.reshape(-1, _LANE)
+    p2, g2 = flat(p), flat(g)
+    mu2, nu2 = flat(mu, jnp.float32), flat(nu, jnp.float32)
+    rows = p2.shape[0]
+    br = _row_block(rows)
+    blk = lambda dt: pl.BlockSpec((br, _LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd),
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec(memory_space=_MEMSPACE.SMEM),
+                  blk(pdt), blk(g2.dtype), blk(jnp.float32),
+                  blk(jnp.float32)],
+        out_specs=[blk(pdt), blk(jnp.float32), blk(jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANE), pdt),
+                   jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, _LANE), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS(dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(scal.astype(jnp.float32), p2, g2, mu2, nu2)
+    def unflat(a, dt):
+        return a.reshape(-1)[:n].reshape(shape).astype(dt)
+    return (unflat(out[0], pdt), unflat(out[1], mu.dtype),
+            unflat(out[2], nu.dtype))
+
+
+def fused_adam_tree_update(spec: Dict[str, Any], params, opt_state, grads):
+    """Drop-in for ``tx.update`` + apply: returns ``(new_params,
+    new_opt_state)`` with the update already applied to the params, or
+    ``None`` when the state tuple doesn't match the supported chain.
+    ``grads`` must already be unscaled/clipped (the engine's in-program
+    path) — the kernel's fold scalars are 1 here."""
+    m = match_adam_chain(opt_state)
+    if m is None:
+        return None
+    adam_idx, sched_idx = m
+    adam = opt_state[adam_idx]
+    sched_count = opt_state[sched_idx].count if sched_idx is not None else None
+    neg_lr, bc1, bc2 = step_scalars(spec, adam.count, sched_count)
+    scal = jnp.stack([jnp.float32(1.0), jnp.float32(1.0), neg_lr, bc1, bc2])
+    kw = dict(b1=spec["b1"], b2=spec["b2"], eps=spec["eps"], wd=spec["wd"])
+    flat_p, tdef = jax.tree_util.tree_flatten(params)
+    flat_g = tdef.flatten_up_to(grads)
+    flat_mu = tdef.flatten_up_to(adam.mu)
+    flat_nu = tdef.flatten_up_to(adam.nu)
+    new_p, new_mu, new_nu = [], [], []
+    for p, g, mu, nu in zip(flat_p, flat_g, flat_mu, flat_nu):
+        np_, nm, nn = fused_leaf_update(p, g, mu, nu, scal, **kw)
+        new_p.append(np_); new_mu.append(nm); new_nu.append(nn)
+    new_adam = type(adam)(count=_safe_int32_increment(adam.count),
+                          mu=tdef.unflatten(new_mu),
+                          nu=tdef.unflatten(new_nu))
+    out_state = list(opt_state)
+    out_state[adam_idx] = new_adam
+    if sched_idx is not None:
+        sc = opt_state[sched_idx]
+        out_state[sched_idx] = type(sc)(
+            count=_safe_int32_increment(sc.count))
+    return tdef.unflatten(new_p), tuple(out_state)

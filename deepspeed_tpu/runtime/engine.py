@@ -672,6 +672,7 @@ class DeepSpeedEngine:
 
     def _configure_optimizer(self):
         import optax
+        self._fused_opt_spec = None
         if self.client_optimizer is not None:
             tx = self.client_optimizer
             assert isinstance(tx, optax.GradientTransformation), (
@@ -684,6 +685,11 @@ class DeepSpeedEngine:
             opt_params = dict(self._config.optimizer_params)
             self._configure_onebit_comm(name, opt_params)
             tx = get_optimizer(name, opt_params, lr_schedule=self._schedule_fn)
+            from deepspeed_tpu.ops.pallas import fused_optim
+            lr = (self._schedule_fn if self._schedule_fn is not None
+                  else opt_params.get("lr", 1e-3))
+            self._fused_opt_spec = fused_optim.spec_from_config(
+                name, opt_params, lr)
         self.tx = tx
         opt_shapes = jax.eval_shape(tx.init, self.state.params)
         self.opt_shardings = self.zero_policy.opt_shardings(opt_shapes, self.state.params,
@@ -731,6 +737,133 @@ class DeepSpeedEngine:
         if self.state.opt_state is None and self.optimizer_swapper is not None:
             self.state.opt_state = self.optimizer_swapper.swap_in(self.opt_shardings)
         return self.state.opt_state
+
+    # ------------------------------------------------------------------ #
+    # Fused Pallas optimizer step (ops/pallas/fused_optim.py)
+    # ------------------------------------------------------------------ #
+    def _fused_opt_active(self) -> bool:
+        """Static gate for the fused Adam kernel: a fusable factory config
+        (``_fused_opt_spec``), env opt-in, and an unsharded step — a bare
+        ``pallas_call`` has no SPMD rule, so any >1-device mesh keeps the
+        optax path."""
+        if getattr(self, "_fused_opt_spec", None) is None:
+            return False
+        from deepspeed_tpu.ops.pallas import fused_optim
+        return fused_optim.fused_opt_enabled() and self.mesh.size == 1
+
+    def _fused_offload_walk_ready(self) -> bool:
+        """Whether this step can run the leaf-streamed NVMe walk: fused
+        kernel active, state swapped out, and the swapped template is the
+        adam chain the kernel implements (matched per step so a rollback
+        re-init or a client re-config falls back cleanly)."""
+        if self.optimizer_swapper is None or not self._fused_opt_active():
+            return False
+        if self.stability is not None or not self.optimizer_swapper.is_swapped:
+            return False
+        from deepspeed_tpu.ops.pallas import fused_optim
+        return fused_optim.match_adam_chain(
+            self.optimizer_swapper.template) is not None
+
+    def _fused_offload_step(self):
+        """Leaf-streamed optimizer update against the NVMe-resident state:
+        leaf N's fused kernel launch overlaps leaf N+1's swap-in through
+        the store's prefetch ring, and each updated (m, v) pair streams
+        back out asynchronously — the whole-tree materialization of
+        ``_opt_state_view()`` never happens.  Numerics are the exact
+        ``_apply_updates`` sequence: the unscale/clip scalars are computed
+        by the same ops and folded into the kernel in the same order, so
+        results are bitwise-identical to the unfused offload step."""
+        from deepspeed_tpu.ops.pallas import fused_optim
+        sw = self.optimizer_swapper
+        spec = self._fused_opt_spec
+        tmpl = sw.template
+        adam_idx, sched_idx = fused_optim.match_adam_chain(tmpl)
+        leaves = jax.tree_util.tree_leaves_with_path(tmpl)
+        mu_keys = [sw.leaf_key(p) for p, _ in leaves
+                   if p[0].idx == adam_idx and p[1].name == "mu"]
+        nu_keys = [sw.leaf_key(p) for p, _ in leaves
+                   if p[0].idx == adam_idx and p[1].name == "nu"]
+        count_key = next(sw.leaf_key(p) for p, _ in leaves
+                         if p[0].idx == adam_idx and p[1].name == "count")
+        sched_key = (next(sw.leaf_key(p) for p, _ in leaves
+                          if p[0].idx == sched_idx)
+                     if sched_idx is not None else None)
+
+        if getattr(self, "_fused_prelude_jit", None) is None:
+            clip = self.gradient_clipping()
+            fp16 = self.fp16_enabled
+
+            def prelude(grads, scale, divisor):
+                inv = 1.0 / (scale * divisor)
+                gf = jax.tree.map(lambda g: g.astype(jnp.float32) * inv,
+                                  grads)
+                overflow = (has_overflow(gf) if fp16
+                            else jnp.asarray(False))
+                sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(gf))
+                grad_norm = jnp.sqrt(sq)
+                if clip and clip > 0:
+                    factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                else:
+                    factor = jnp.asarray(1.0, jnp.float32)
+                return overflow, grad_norm, inv, factor
+
+            self._fused_prelude_jit = jax.jit(prelude)
+            self._fused_scalars_jit = jax.jit(
+                partial(fused_optim.step_scalars, spec))
+            self._fused_leaf_jit = jax.jit(partial(
+                fused_optim.fused_leaf_update, b1=spec["b1"], b2=spec["b2"],
+                eps=spec["eps"], wd=spec["wd"]))
+            self._fused_incr_jit = jax.jit(fused_optim._safe_int32_increment)
+
+        # moment prefetch for the first leaves can start under the prelude
+        sw.prefetch_leaf(count_key)
+        if sched_key is not None:
+            sw.prefetch_leaf(sched_key)
+        for k in (mu_keys[:1] + nu_keys[:1]):
+            sw.prefetch_leaf(k)
+        grads = self.state.grad_acc
+        overflow, grad_norm, inv, factor = self._fused_prelude_jit(
+            grads, self.state.scaler.scale,
+            jnp.asarray(self._grad_accum_divisor(), jnp.float32))
+        skip = bool(overflow) if self.fp16_enabled else False
+        if skip:
+            # same semantics as skip_step: state untouched (still durable
+            # on NVMe), scaler reacts, skipped advances
+            self.state.scaler = update_scale(self.state.scaler, overflow)
+            self.state.skipped = self.state.skipped + 1
+            return {"grad_norm": grad_norm, "overflow": overflow,
+                    "loss_scale": self.state.scaler.scale}
+
+        count = sw.swap_in_leaf(count_key)
+        sched_count = (sw.swap_in_leaf(sched_key)
+                       if sched_key is not None else None)
+        neg_lr, bc1, bc2 = self._fused_scalars_jit(count, sched_count)
+        scal = jnp.stack([inv.astype(jnp.float32), factor, neg_lr, bc1, bc2])
+
+        flat_p, pdef = jax.tree_util.tree_flatten(self.state.params)
+        flat_g = pdef.flatten_up_to(grads)
+        assert len(flat_p) == len(mu_keys) == len(nu_keys), (
+            "optimizer state template does not match the parameter tree")
+        new_p = []
+        for i, (p, g) in enumerate(zip(flat_p, flat_g)):
+            if i + 1 < len(flat_p):
+                sw.prefetch_leaf(mu_keys[i + 1])
+                sw.prefetch_leaf(nu_keys[i + 1])
+            mu = sw.swap_in_leaf(mu_keys[i])
+            nu = sw.swap_in_leaf(nu_keys[i])
+            np_, nm, nn = self._fused_leaf_jit(p, g, mu, nu, scal)
+            # async writeback: the store drains while the next leaf's
+            # kernel runs (and the next forward, for the tail leaves)
+            sw.swap_out_leaf(mu_keys[i], nm)
+            sw.swap_out_leaf(nu_keys[i], nn)
+            new_p.append(np_)
+        sw.swap_out_leaf(count_key, self._fused_incr_jit(count))
+        if sched_key is not None:
+            sw.swap_out_leaf(sched_key, self._fused_incr_jit(sched_count))
+        self.state.params = pdef.unflatten(new_p)
+        self.state.scaler = update_scale(self.state.scaler, overflow)
+        return {"grad_norm": grad_norm, "overflow": overflow,
+                "loss_scale": self.state.scaler.scale}
 
     def _offload_devices(self):
         """(param_tier, optimizer_tier) as plain strings (none/cpu/nvme)."""
@@ -1669,6 +1802,15 @@ class DeepSpeedEngine:
 
         def do_step(args):
             params, opt_state, grads = args
+            if not momentum_mode and self._fused_opt_active():
+                from deepspeed_tpu.ops.pallas import fused_optim
+                # the grads here are already unscaled + clipped, so the
+                # kernel's fold scalars are 1 and parity vs tx.update is
+                # bitwise; a chain the kernel can't fuse returns None
+                out = fused_optim.fused_adam_tree_update(
+                    self._fused_opt_spec, params, opt_state, grads)
+                if out is not None:
+                    return out
             updates, new_opt = self.tx.update(grads, opt_state, params)
             return jax.tree.map(lambda p, u: (p + u).astype(p.dtype), params, updates), new_opt
 
@@ -2102,9 +2244,15 @@ class DeepSpeedEngine:
                 if self._apply_step is None:
                     self._apply_step = self._build_apply_step()
                 apply = self._apply_step
+            fused_walk = (not momentum_mode
+                          and self._fused_offload_walk_ready())
             with self._span("step", step=self.global_steps,
                             onebit=momentum_mode):
-                if self.stability is not None:
+                if fused_walk:
+                    # leaf-streamed NVMe walk: update leaf N while leaf
+                    # N+1 swaps in; state never materializes as a tree
+                    stats = self._fused_offload_step()
+                elif self.stability is not None:
                     loss_in = (self._cached_loss if self._cached_loss is not None
                                else jnp.zeros((), jnp.float32))
                     (self.state.params, self.state.opt_state, self.state.scaler,
@@ -2122,7 +2270,7 @@ class DeepSpeedEngine:
             # the applied update changed the params: a persisted hpZ
             # secondary shard is stale from here on
             self._hpz_secondary = None
-            if self.optimizer_swapper is not None:
+            if self.optimizer_swapper is not None and not fused_walk:
                 # stream the updated state back to NVMe; device copy released
                 self.optimizer_swapper.swap_out(self.state.opt_state)
                 self.state.opt_state = None

@@ -70,6 +70,24 @@ class PartitionedOptimizerSwapper:
                                          prefix=self.PREFIX)
         return out
 
+    @property
+    def template(self):
+        """Shape/dtype pytree of the swapped state (None when resident)."""
+        return self._template
+
+    # ---- single-leaf surface (engine._fused_offload_step) ------------- #
+    def leaf_key(self, path) -> str:
+        return self._swapper.leaf_key(path, prefix=self.PREFIX)
+
+    def prefetch_leaf(self, key: str) -> None:
+        self._swapper.prefetch_leaf(key)
+
+    def swap_in_leaf(self, key: str):
+        return self._swapper.swap_in_leaf(key)
+
+    def swap_out_leaf(self, key: str, value, sync: bool = False) -> None:
+        self._swapper.swap_out_leaf(key, value, sync=sync)
+
     def swapped_bytes(self) -> int:
         return self._swapper.swapped_bytes()
 

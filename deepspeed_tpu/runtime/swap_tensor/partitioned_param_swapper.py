@@ -115,6 +115,44 @@ class AsyncPartitionedParameterSwapper:
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(tree_def_like), leaves)
 
+    # ---- single-leaf surface (the engine's fused optimizer walk) ------ #
+    def leaf_key(self, path, prefix: str = "p") -> str:
+        """The swap key ``swap_out_tree`` used for this leaf path."""
+        return f"{prefix}__{_leaf_key(path)}"
+
+    def prefetch_leaf(self, key: str) -> None:
+        """Async read of one leaf's chunks (no-op for an unknown key)."""
+        meta = self._meta.get(key)
+        if meta is None:
+            return
+        n_chunks = meta[2]
+        self.store.prefetch([self._chunk_key(key, i)
+                             for i in range(n_chunks)] if n_chunks
+                            else [key])
+
+    def swap_in_leaf(self, key: str):
+        """One leaf back as a host array (joins its prefetches)."""
+        shape, dtype, n_chunks = self._meta[key]
+        if n_chunks:
+            buf = np.stack([self.store.get(self._chunk_key(key, i))
+                            for i in range(n_chunks)])
+            return buf.reshape(shape).astype(dtype, copy=False)
+        return self.store.get(key)
+
+    def swap_out_leaf(self, key: str, value, sync: bool = False) -> None:
+        """Write one leaf (async unless ``sync``) — the fused walk's
+        per-leaf writeback, draining while later leaves compute."""
+        host = np.asarray(value)
+        n_chunks = self._chunked(key, host.shape)
+        self._meta[key] = (host.shape, host.dtype, n_chunks)
+        if n_chunks:
+            for i in range(n_chunks):
+                self.store.put(self._chunk_key(key, i), host[i])
+        else:
+            self.store.put(key, host)
+        if sync:
+            self.store.drain()
+
     def swapped_bytes(self) -> int:
         return self.pool.snapshot()["bytes_written"]
 

@@ -243,6 +243,90 @@ def test_alibi_slopes_gqa():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+# --------------------------------------------------------------------------- #
+# Shape-survival sweep: every (S, heads) combination must produce a correct
+# answer — either through the kernel (blocks fitted to S) or through the
+# one-shot-warned reference fallback — never a lowering error.  S=1 is the
+# decode-like (1, 1, 128) cliff that used to throw before _block_sizes
+# learned to clamp; S=1000 is indivisible by any legal block and must demote.
+# --------------------------------------------------------------------------- #
+SWEEP_S = [1, 8, 64, 128, 1000]
+SWEEP_H = [1, 2, 12]
+
+
+@pytest.mark.parametrize("H", SWEEP_H)
+@pytest.mark.parametrize("S", SWEEP_S)
+def test_shape_sweep_forward_parity(S, H):
+    q, k, v = make_qkv(B=1, S=S, H=H, D=32, seed=17)
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5,
+                               err_msg=f"S={S} H={H}")
+
+
+@pytest.mark.parametrize("S", [1, 8, 1000])
+def test_shape_sweep_backward_parity(S):
+    q, k, v = make_qkv(B=1, S=S, H=2, D=32, seed=18)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch (S={S})")
+
+
+def test_decode_cliff_1_1_128():
+    """The (1, 1, 128) repro: batch 1, one query token, D=128 — the exact
+    shape the decode path hands the kernel, which the old divisibility
+    check rejected and the old block fitter lowered into a Mosaic error."""
+    q, k, v = make_qkv(B=1, S=1, H=1, D=128, seed=19)
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    assert out.shape == (1, 1, 1, 128)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_shape_sweep_gqa():
+    """GQA across the sweep's odd sizes (kernel path for small S, fallback
+    path for the indivisible S) keeps head-group semantics."""
+    for S in (1, 8, 1000):
+        q, k, v = make_gqa(B=1, S=S, H=4, Hkv=2, D=32, seed=20)
+        out = flash_attention(q, k, v, causal=True)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5,
+                                   err_msg=f"S={S}")
+
+
+def test_block_fitting_and_fallback_telemetry():
+    """_block_sizes must emit Mosaic-legal blocks for every small S (full-S
+    blocks below the caps), the indivisible S=1000 must be detected as
+    non-lowerable, and the demotion warning must fire exactly once per
+    shape (telemetry, not log spam)."""
+    from deepspeed_tpu.ops.pallas import flash_attention as fa
+
+    for S in (1, 3, 8, 13, 64, 128, 255):
+        bq, bk = fa._block_sizes(S, None, None)
+        assert bq == S and bk == S, (S, bq, bk)
+        assert fa._blocks_lowerable(S, bq, bk)
+    # large divisible S keeps the tuned caps
+    assert fa._block_sizes(1024, None, None) == (256, 512)
+    # indivisible: fitted blocks exist but are not sublane-aligned
+    bq, bk = fa._block_sizes(1000, None, None)
+    assert 1000 % bq == 0 and 1000 % bk == 0
+    assert not fa._blocks_lowerable(1000, bq, bk)
+    # explicit DST_FLASH_BQ/BK-style requests are clamped, never trusted
+    assert fa._block_sizes(64, 256, 512) == (64, 64)
+
+    fa._FALLBACK_WARNED.clear()
+    q, k, v = make_qkv(B=1, S=1000, H=1, D=32, seed=21)
+    flash_attention(q, k, v, causal=True)
+    flash_attention(q, k, v, causal=True)
+    assert len(fa._FALLBACK_WARNED) == 1   # one shape+reason key, one warn
+
+
 @pytest.mark.parametrize("rank", [2, 3])
 def test_low_rank_bias(rank):
     """The contract says 'broadcastable to [B, H, S, S]' — rank-2/3 biases

@@ -1,0 +1,116 @@
+"""In-process bench rung cancellation: a deliberately-stalled fake rung
+must be cancelled by ``bench._run_rung_cancellable`` within the watchdog
+budget — flight-recorder hook fired, ``RungCancelled`` raised on the
+calling thread, worker abandoned — while live rungs (fast, slow-but-
+petting, or raising) behave exactly as before."""
+
+import importlib.util
+import os
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.telemetry.watchdog import HangWatchdog
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+class _StallRecorder:
+    """Stands in for the flight recorder's ``on_stall``."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, watchdog, stalled_s, what):
+        self.calls.append((stalled_s, what))
+
+
+def _watchdog(timeout_s, recorder):
+    # no .start(): the cancellable runner polls check() itself, so the
+    # test never depends on the background poll thread's cadence
+    return HangWatchdog(timeout_s=timeout_s, on_stall=recorder)
+
+
+class TestRungCancellation:
+
+    def test_stalled_rung_cancelled_within_budget(self):
+        recorder = _StallRecorder()
+        wd = _watchdog(0.3, recorder)
+        release = threading.Event()   # lets the abandoned worker exit
+
+        def wedged_rung():
+            release.wait(30.0)        # no heartbeat: a dead-air stall
+
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(bench.RungCancelled, match="wedged"):
+                bench._run_rung_cancellable("wedged", wedged_rung, wd, 0.3)
+            elapsed = time.monotonic() - t0
+            # budget is 0.3s; cancellation must land well inside the
+            # driver-visible window (poll slice + stall check overhead)
+            assert elapsed < 3.0, f"cancellation took {elapsed:.2f}s"
+            # the flight-recorder hook fired exactly once, scoped to the rung
+            assert len(recorder.calls) == 1
+            stalled_s, what = recorder.calls[0]
+            assert "wedged" in what
+            assert stalled_s >= 0.3
+            # runner disarms on the way out even when cancelling
+            assert not wd.armed
+        finally:
+            release.set()
+
+    def test_fast_rung_returns_value(self):
+        recorder = _StallRecorder()
+        wd = _watchdog(5.0, recorder)
+        out = bench._run_rung_cancellable("fast", lambda: {"value": 42},
+                                          wd, 5.0)
+        assert out == {"value": 42}
+        assert recorder.calls == []
+        assert not wd.armed
+
+    def test_slow_but_petting_rung_survives(self):
+        """Cancellation keys off the STALL condition, not wall-clock: a
+        rung that outlives the budget but keeps heartbeating (as every
+        tracer span does) must run to completion."""
+        recorder = _StallRecorder()
+        wd = _watchdog(0.25, recorder)
+
+        def slow_but_alive():
+            for _ in range(8):        # ~0.6s total, > 0.25s budget
+                time.sleep(0.075)
+                wd.pet()
+            return "done"
+
+        assert bench._run_rung_cancellable(
+            "slow", slow_but_alive, wd, 0.25) == "done"
+        assert recorder.calls == []
+
+    def test_rung_exception_propagates_to_caller(self):
+        wd = _watchdog(5.0, _StallRecorder())
+
+        def broken():
+            raise ValueError("rung blew up")
+
+        with pytest.raises(ValueError, match="rung blew up"):
+            bench._run_rung_cancellable("broken", broken, wd, 5.0)
+        assert not wd.armed
+
+    def test_cancelled_is_distinguishable_from_failure(self):
+        """The all-mode loop catches RungCancelled BEFORE Exception to
+        mark the rung degraded/cancelled; the ordering only works if the
+        type stays a RuntimeError subclass with its own identity."""
+        assert issubclass(bench.RungCancelled, RuntimeError)
+        assert bench.RungCancelled is not RuntimeError

@@ -17,7 +17,7 @@ sys.path.insert(0, REPO_ROOT)
 
 from tools.dslint import core  # noqa: E402
 from tools.dslint import (jaxpr_checks, lock_discipline, monotonic,  # noqa: E402
-                          overlap, stale_pragma, zero_sync)
+                          overlap, pallas_discipline, stale_pragma, zero_sync)
 
 
 def _scan(tmp_path, src, name="fixture.py", ctx=None):
@@ -37,10 +37,10 @@ class TestRepoClean:
         jaxpr pass is exercised through the CLI test below — one trace.)"""
         findings, ctx = core.run_passes(only=[
             "zero-sync", "lock-discipline", "monotonic", "overlap",
-            "stale-pragma"])
+            "pallas-discipline", "stale-pragma"])
         assert findings == [], "\n".join(f.format() for f in findings)
         assert ctx.ran == ["zero-sync", "lock-discipline", "monotonic",
-                           "overlap", "stale-pragma"]
+                           "overlap", "pallas-discipline", "stale-pragma"]
 
     def test_cli_full_run_clean_with_jaxpr_proof(self):
         """``python -m tools.dslint --json`` exits 0 on the repo, and the
@@ -55,7 +55,8 @@ class TestRepoClean:
         report = json.loads(proc.stdout)
         assert report["clean"] is True
         assert report["passes_run"] == ["zero-sync", "lock-discipline",
-                                        "monotonic", "overlap", "jaxpr",
+                                        "monotonic", "overlap",
+                                        "pallas-discipline", "jaxpr",
                                         "stale-pragma"]
         jx = report["meta"]["jaxpr"]
         for program in ("layered-step", "bulk-step", "serving-decode"):
@@ -435,3 +436,92 @@ class TestStoreGetPutRace:
         np.testing.assert_array_equal(got, new)
         np.testing.assert_array_equal(store.get("k"), new)
         pool.close()
+
+
+# --------------------------------------------------------------------------- #
+# pallas-discipline (PR 14): static trip counts + predicated DMA pairing
+# --------------------------------------------------------------------------- #
+
+_KERNEL_FIXTURE = (
+    "import jax\n"
+    "from jax import lax\n"
+    "from jax.experimental import pallas as pl\n"
+    "\n"
+    "def bad_trip(pos_ref, o_ref):\n"
+    "    nk = (pos_ref[0] + 7) // 8\n"
+    "    lax.fori_loop(0, nk, lambda i, c: c, 0)\n"
+    "\n"
+    "def bad_trip_direct(pos_ref, o_ref):\n"
+    "    lax.fori_loop(0, pl.load(pos_ref, (0,)), lambda i, c: c, 0)\n"
+    "\n"
+    "def good_trip(x_ref, o_ref, *, nk_max):\n"
+    "    nk = pl.cdiv(x_ref.shape[0], 8)\n"
+    "    lax.fori_loop(0, nk_max, lambda i, c: c, 0)\n"
+    "    lax.fori_loop(0, nk, lambda i, c: c, 0)\n"
+    "\n"
+    "def bad_dma(cp, pred, c):\n"
+    "    return lax.cond(pred, lambda x: cp.start(), lambda x: cp.wait(), c)\n"
+    "\n"
+    "def good_dma(cp, pred, c):\n"
+    "    def live(x):\n"
+    "        cp.start()\n"
+    "        cp.wait()\n"
+    "        return x\n"
+    "    return lax.cond(pred, live, lambda x: x, c)\n")
+
+
+class TestPallasDisciplinePass:
+    def test_flags_data_dependent_trip_counts(self, tmp_path):
+        sf, _ = _scan(tmp_path, _KERNEL_FIXTURE)
+        msgs = [m for _, m in pallas_discipline.fori_violations(sf)]
+        assert len(msgs) == 2, msgs
+        assert all("data-dependent" in m for m in msgs)
+
+    def test_static_and_shape_derived_bounds_are_clean(self, tmp_path):
+        sf, _ = _scan(tmp_path, _KERNEL_FIXTURE)
+        lines = [ln for ln, _ in pallas_discipline.fori_violations(sf)]
+        src_lines = _KERNEL_FIXTURE.splitlines()
+        for ln in lines:
+            assert "good" not in src_lines[ln - 1]
+
+    def test_flags_unpaired_dma_across_cond_branches(self, tmp_path):
+        sf, _ = _scan(tmp_path, _KERNEL_FIXTURE)
+        msgs = [m for _, m in pallas_discipline.dma_violations(sf)]
+        # both branches of bad_dma are unbalanced (1/0 and 0/1); good_dma's
+        # live() branch is 1/1 and its identity branch 0/0
+        assert len(msgs) == 2, msgs
+        assert any("true branch" in m for m in msgs)
+        assert any("false branch" in m for m in msgs)
+
+    def test_named_branch_functions_are_resolved(self, tmp_path):
+        sf, _ = _scan(tmp_path, (
+            "from jax import lax\n"
+            "def leak(x):\n"
+            "    cp.start()\n"
+            "    return x\n"
+            "def k(cp, pred, c):\n"
+            "    return lax.cond(pred, leak, lambda x: x, c)\n"))
+        msgs = [m for _, m in pallas_discipline.dma_violations(sf)]
+        assert len(msgs) == 1 and "1 DMA start() but 0 wait()" in msgs[0]
+
+    def test_pragma_opt_out(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def k(pos_ref, o_ref):\n"
+            "    n = pos_ref[0]\n"
+            "    # dslint: ok(pallas-discipline) - bounded by grid above\n"
+            "    lax.fori_loop(0, n, lambda i, c: c, 0)\n")
+        sf, ctx = _scan(tmp_path, src)
+        viol = list(pallas_discipline.fori_violations(sf))
+        assert len(viol) == 1
+        lineno = viol[0][0]
+        assert ctx.sanctioned(sf, lineno, "pallas-discipline")
+
+    def test_repo_kernels_clean(self):
+        findings, _ = core.run_passes(only=["pallas-discipline"])
+        assert findings == [], "\n".join(f.format() for f in findings)
+        # the pass actually scanned the kernel dir (not vacuously clean)
+        rels = pallas_discipline.kernel_files(core.REPO_ROOT)
+        assert any(r.endswith("decode_attention.py") for r in rels)
+        assert any(r.endswith("cross_entropy.py") for r in rels)
+        assert any(r.endswith("fused_optim.py") for r in rels)

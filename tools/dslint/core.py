@@ -267,12 +267,14 @@ def all_passes() -> List[LintPass]:
     """The registered pass set, in execution order.  Imported lazily so
     the cheap passes never pay for the jaxpr pass's jax import."""
     from tools.dslint import (jaxpr_checks, lock_discipline, monotonic,
-                              overlap, stale_pragma, zero_sync)
+                              overlap, pallas_discipline, stale_pragma,
+                              zero_sync)
     return [
         zero_sync.ZeroSyncPass(),
         lock_discipline.LockDisciplinePass(),
         monotonic.MonotonicPass(),
         overlap.OverlapPass(),
+        pallas_discipline.PallasDisciplinePass(),
         jaxpr_checks.JaxprPass(),
         stale_pragma.StalePragmaPass(),
     ]

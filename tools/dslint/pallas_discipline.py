@@ -1,0 +1,177 @@
+"""pallas-discipline pass — TPU kernel-source rules over ``ops/pallas/``.
+
+Codifies the PR 8 v5e wedge post-mortem: the decode kernel originally
+(a) derived a ``fori_loop`` trip count from a scalar it had just read out
+of a kernel ref — Mosaic cannot bound such a loop, and on hardware the
+lowering either fails or (worse) emits a loop the sequencer can wedge on
+— and (b) issued an async-copy ``start()`` in one ``lax.cond`` branch
+with the matching ``wait()`` outside it, so the not-taken branch waited
+on a DMA that was never issued.  The shipped fix is static trip counts
+with predicated bodies, and DMAs started AND waited inside the same
+branch.  This pass makes both rules mechanical for every kernel file:
+
+* **data-dependent trip count**: a ``fori_loop`` lower/upper bound whose
+  expression (resolved one assignment deep through local names) reads a
+  kernel ref (``*_ref[...]`` subscript or ``pl.load(...)``).  Grid- and
+  shape-derived bounds (``pl.cdiv(...)``, ``x.shape[i]``, static kwargs)
+  are fine — refs are the poison, and predicating with ``lax.cond``
+  inside a static-bound loop is the sanctioned pattern.
+* **unpaired DMA across cond branches**: a ``lax.cond`` branch (lambda
+  or same-file function) whose ``.start()`` and ``.wait()`` call counts
+  differ — the branch either abandons an in-flight copy or waits on one
+  it never issued.
+
+Escape hatch: ``# dslint: ok(pallas-discipline) — <reason>``.
+"""
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.dslint.core import (Context, Finding, LintPass, ScannedFile,
+                               dotted_name)
+
+PASS_NAME = "pallas-discipline"
+
+#: every .py under this directory is in scope
+KERNEL_DIR = "deepspeed_tpu/ops/pallas"
+
+_HINT_TRIP = ("Mosaic needs static trip counts: loop over the static "
+              "maximum and predicate the body with lax.cond, or mark "
+              "'# dslint: ok(pallas-discipline) - <reason>'")
+_HINT_DMA = ("start and wait the copy inside the same branch (predicated "
+             "DMA), or mark '# dslint: ok(pallas-discipline) - <reason>'")
+
+_MAX_RESOLVE_DEPTH = 4
+
+
+def kernel_files(repo_root: str) -> List[str]:
+    root = os.path.join(repo_root, KERNEL_DIR)
+    return [f"{KERNEL_DIR}/{f}" for f in sorted(os.listdir(root))
+            if f.endswith(".py")]
+
+
+def _is_ref_read(node: ast.AST) -> bool:
+    """A direct kernel-ref read: ``x_ref[...]`` / ``ref.at[...]`` or a
+    ``pl.load(...)`` call."""
+    if isinstance(node, ast.Subscript):
+        # ``x_ref[...]`` is a read; ``x_ref.shape[0]`` is static metadata
+        name = dotted_name(node.value)
+        if name and name.endswith("_ref"):
+            return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "load":
+            return True
+    return False
+
+
+def _scope_assigns(fn_node: ast.AST) -> Dict[str, ast.AST]:
+    """name -> assigned expression for simple single-target assignments
+    directly inside this function (nested defs keep their own scope)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _expr_reads_ref(expr: ast.AST, assigns: Dict[str, ast.AST],
+                    depth: int = 0) -> bool:
+    """Whether ``expr`` (chasing local names ``depth`` levels) contains a
+    kernel-ref read."""
+    if depth > _MAX_RESOLVE_DEPTH:
+        return False
+    for node in ast.walk(expr):
+        if _is_ref_read(node):
+            return True
+        if isinstance(node, ast.Name) and node.id in assigns:
+            target = assigns[node.id]
+            if target is not expr and _expr_reads_ref(
+                    target, {k: v for k, v in assigns.items()
+                             if k != node.id}, depth + 1):
+                return True
+    return False
+
+
+def fori_violations(sf: ScannedFile) -> Iterator[Tuple[int, str]]:
+    """(lineno, message) for every fori_loop whose bounds read a ref."""
+    funcs = [n for n in ast.walk(sf.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        assigns = _scope_assigns(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if not name.endswith("fori_loop"):
+                continue
+            for which, bound in zip(("lower", "upper"), node.args[:2]):
+                if _expr_reads_ref(bound, assigns):
+                    yield node.lineno, (
+                        f"fori_loop {which} bound is data-dependent "
+                        "(derived from a kernel ref read) — Mosaic "
+                        "cannot lower a dynamic trip count")
+
+
+def _branch_body(branch: ast.AST, sf: ScannedFile) -> Optional[ast.AST]:
+    if isinstance(branch, ast.Lambda):
+        return branch.body
+    if isinstance(branch, ast.Name):
+        fn = sf.find_function(branch.id)
+        if fn is not None:
+            return fn
+    return None
+
+
+def _dma_counts(root: ast.AST) -> Tuple[int, int]:
+    starts = waits = 0
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "start":
+                starts += 1
+            elif node.func.attr == "wait":
+                waits += 1
+    return starts, waits
+
+
+def dma_violations(sf: ScannedFile) -> Iterator[Tuple[int, str]]:
+    """(lineno, message) for every lax.cond branch whose DMA ``start()``
+    and ``wait()`` counts differ."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        if not name.endswith("lax.cond") and name != "cond":
+            continue
+        for label, branch in zip(("true", "false"), node.args[1:3]):
+            body = _branch_body(branch, sf)
+            if body is None:
+                continue
+            starts, waits = _dma_counts(body)
+            if starts != waits:
+                yield branch.lineno, (
+                    f"{label} branch of lax.cond has {starts} DMA "
+                    f"start() but {waits} wait() — the not-taken path "
+                    "abandons or blocks on an in-flight copy")
+
+
+class PallasDisciplinePass(LintPass):
+    name = PASS_NAME
+    description = ("ops/pallas kernels: static fori_loop trip counts and "
+                   "DMA start()/wait() paired within each lax.cond branch")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in kernel_files(ctx.repo_root):
+            sf = ctx.scan(rel, for_pass=self.name)
+            for lineno, msg in fori_violations(sf):
+                if not ctx.sanctioned(sf, lineno, self.name):
+                    out.append(Finding(self.name, sf.rel, lineno, msg,
+                                       hint=_HINT_TRIP))
+            for lineno, msg in dma_violations(sf):
+                if not ctx.sanctioned(sf, lineno, self.name):
+                    out.append(Finding(self.name, sf.rel, lineno, msg,
+                                       hint=_HINT_DMA))
+        return out
