@@ -134,6 +134,13 @@ def bench_train():
     steps = int(os.environ.get("BENCH_STEPS", "16"))
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
 
+    # goodput attribution over the whole rung: setup/compile falls to
+    # idle_other (mark() below draws the line after warmup), the timed
+    # window is claimed productive by one on_step() — the stamp gives the
+    # trend tool the compile-vs-steady split for free
+    from deepspeed_tpu.telemetry.ledger import GoodputLedger
+    ledger = GoodputLedger(mode="train")
+
     cfg = gpt_config(preset, n_positions=seq, scan_layers=True,
                      remat=remat,
                      attn_impl=os.environ.get("BENCH_ATTN", "auto"))
@@ -151,8 +158,11 @@ def bench_train():
         loss = engine.train_batch(batch=batch)
     float(loss)
 
+    ledger.mark()
+
     per_step, loss_val = _chain_timer(
         lambda: engine.train_batch(batch=batch), lambda l: float(l), steps=steps)
+    ledger.on_step(steps)
 
     samples_per_sec = global_batch / per_step
     tflops = samples_per_sec * seq * model.flops_per_token(seq) / n_dev / 1e12
@@ -165,6 +175,10 @@ def bench_train():
         "samples_per_sec": round(samples_per_sec, 2),
         "loss": round(loss_val, 4),
     }
+    snap = ledger.snapshot()
+    rec["goodput"] = {"goodput_frac": round(snap["goodput_frac"], 4),
+                      "categories": {k: round(v, 3)
+                                     for k, v in snap["categories"].items()}}
     if os.environ.get("BENCH_KERNEL_TRUTH", "1") == "1":
         # kernel-truth column: measured FLOPs/time attribution off a traced
         # representative step — best-effort so the headline survives any
@@ -599,6 +613,14 @@ def bench_serve():
     if hub is not None:
         if obs is None:                     # short run: scrape before close
             obs = _scrape_obs(hub)
+        if hub.ledger is not None:          # per-SLO token goodput stamp
+            snap = hub.ledger.snapshot()
+            rec["goodput"] = {
+                "goodput_frac": round(snap["goodput_frac"], 4),
+                "categories": {k: round(v, 3)
+                               for k, v in snap["categories"].items()}}
+            if snap.get("serve"):
+                rec["goodput"]["serve"] = snap["serve"]
         jsonl = os.path.join(tmp, "telemetry.jsonl")
         eng.close()
         hub.close()
@@ -883,6 +905,13 @@ def bench_offload():
         except HBMBudgetError:
             pass
 
+        goodput = None
+        if (e_off.telemetry is not None
+                and e_off.telemetry.ledger is not None):
+            snap = e_off.telemetry.ledger.snapshot()
+            goodput = {"goodput_frac": round(snap["goodput_frac"], 4),
+                       "categories": {k: round(v, 3)
+                                      for k, v in snap["categories"].items()}}
         if e_off.telemetry is not None:
             e_off.telemetry.close()
         spec = importlib.util.spec_from_file_location(
@@ -916,6 +945,7 @@ def bench_offload():
             "bytes_staged_in": audit.get("bytes_read"),
             "audit_ok": (audit.get("stall_frac") is not None
                          and audit["stall_frac"] <= max_stall),
+            "goodput": goodput,
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -1026,6 +1056,32 @@ def _detail_path():
     rounds = [int(m.group(1)) for f in glob.glob(os.path.join(here, "BENCH_r*.json"))
               if (m := re.search(r"BENCH_r(\d+)\.json$", f))]
     return os.path.join(here, f"BENCH_DETAIL_r{max(rounds, default=0) + 1:02d}.json")
+
+
+def _trend_postamble():
+    """Cross-round trend line (tools/bench_trend.py) after the detail
+    write: one stderr JSON line comparing this suite's rounds, degraded
+    rounds excluded.  Advisory only — never changes the bench exit code.
+    Opt out with BENCH_SKIP_TREND=1."""
+    if os.environ.get("BENCH_SKIP_TREND") == "1":
+        return
+    try:
+        import importlib.util
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_bench_trend", os.path.join(here, "tools",
+                                                "bench_trend.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        usable, excluded = mod.load_rounds(here)
+        if not usable:
+            return
+        line = {"bench_trend": mod.trend(usable, 0.1),
+                "rounds_excluded": len(excluded)}
+        print(json.dumps(line), file=sys.stderr)
+    except Exception as e:
+        print(json.dumps({"bench_trend_error": str(e)[:200]}),
+              file=sys.stderr)
 
 
 def _bench_recorder():
@@ -1289,6 +1345,7 @@ def main():
             json.dump(detail, f, indent=1)
     except OSError:
         pass
+    _trend_postamble()
     if "error" in detail.get("train", {}):
         # the headline rung failed: exit loudly so the driver records a
         # failure, not the previous rung's line as the headline

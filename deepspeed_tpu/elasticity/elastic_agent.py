@@ -118,6 +118,27 @@ class DSElasticAgent:
         except Exception as e:
             logger.warning(f"elastic agent: worker_exit emission failed: {e}")
 
+    def _emit_downtime(self, t_down: float, reason: str, exit_code):
+        """Structured ``downtime`` record: the worker_exit→restart gap
+        (detection + reap + backoff + relaunch), the raw material for the
+        goodput ledger's cross-attempt ``downtime`` category
+        (``telemetry/ledger.py:fold_goodput``)."""
+        if self.telemetry is None:
+            return
+        try:
+            self.telemetry.emit("downtime", {
+                "downtime_s": time.monotonic() - t_down,
+                "backoff_s": self._last_backoff_s,
+                "reason": reason,
+                "exit_code": exit_code,
+                "restart_count": self.restart_count,
+                "preemption_count": self.preemption_count,
+                "world_size": self._world,
+            })
+            self.telemetry.flush()
+        except Exception as e:
+            logger.warning(f"elastic agent: downtime emission failed: {e}")
+
     # ------------------------------------------------------------------ #
     def _elastic_env(self, world: int) -> Dict[str, str]:
         env = dict(os.environ)
@@ -212,8 +233,10 @@ class DSElasticAgent:
                     log_dist(f"elastic agent: workers preempted (rc={rc}, "
                              f"uptime {uptime:.1f}s) — restarting "
                              f"immediately", ranks=[0])
+                    t_down = time.monotonic()
                     self._stop(reason="preemption")
                     self._start(self.world_size_fn())
+                    self._emit_downtime(t_down, "preemption", rc)
                     continue
                 if uptime >= self.stability_window_s and self.restart_count:
                     # the group ran long enough to call the previous
@@ -234,9 +257,11 @@ class DSElasticAgent:
                 log_dist(f"elastic agent: worker failure rc={rc} — restart "
                          f"{self.restart_count}/{self.max_restarts} in "
                          f"{self._last_backoff_s:.2f}s", ranks=[0])
+                t_down = time.monotonic()
                 self._stop(reason="worker_failure")
                 self._sleep(self._last_backoff_s)
                 self._start(self.world_size_fn())
+                self._emit_downtime(t_down, "worker_failure", rc)
                 continue
             world = self.world_size_fn()
             if world != self._world:
@@ -244,8 +269,13 @@ class DSElasticAgent:
                 # re-solved batch config; checkpoints reshard on resume
                 log_dist(f"elastic agent: membership {self._world} -> {world}; "
                          f"restarting", ranks=[0])
-                self._stop(reason=f"membership_change:{self._world}->{world}")
+                t_down = time.monotonic()
+                old_world = self._world
+                self._last_backoff_s = 0.0
+                self._stop(reason=f"membership_change:{old_world}->{world}")
                 self._start(world)
+                self._emit_downtime(
+                    t_down, f"membership_change:{old_world}->{world}", rc)
             if max_steps is not None and ticks >= max_steps:
                 self._stop(reason="max_steps")
                 return 0

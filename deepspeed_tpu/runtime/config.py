@@ -105,6 +105,10 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigModel):
     tracing: bool = False
     trace_dir: str = ""                  # "" → no export on close
     trace_buffer_size: int = 65536       # completed-span ring capacity
+    # goodput/efficiency attribution ledger (README § Goodput)
+    goodput: bool = True                 # GoodputLedger on the metrics plane
+    efficiency_json_path: str = ""       # "" → EFFICIENCY.json next to jsonl
+    goodput_peak_tflops_per_chip: float = 0.0   # >0 enables the MFU gauge
     # hang watchdog + flight recorder
     watchdog_enabled: bool = False
     watchdog_timeout_s: float = 120.0    # stall threshold (monotonic)

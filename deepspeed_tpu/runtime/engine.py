@@ -33,6 +33,7 @@ TPU-first redesign:
 """
 
 import os
+import time
 from contextlib import nullcontext
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
@@ -281,6 +282,8 @@ class DeepSpeedEngine:
         self._step_fps = []           # batch fingerprints of the open window
         self._last_fp = ""            # fingerprint of the latest micro-batch
         self._skip_micro = False      # quarantined forward → backward no-ops
+        self._skipped_micros_step = 0  # skips in the open step (ledger share)
+        self._last_offload_wait_ms = 0.0   # last step's staging stall (ledger)
         self._scale_pinned_warned = False
         if self._stability_cfg.enabled:
             from deepspeed_tpu.runtime.stability import StabilitySentinel
@@ -951,6 +954,7 @@ class DeepSpeedEngine:
         ``offload_staged`` every step (bytes in/out, ring hits/misses per
         store) and ``offload_wait`` whenever the step actually blocked on
         staged I/O — the stall ``tools/offload_audit.py`` gates on."""
+        self._last_offload_wait_ms = 0.0
         if self.telemetry is None:
             return
         comps = self._offload_components()
@@ -975,6 +979,7 @@ class DeepSpeedEngine:
         rec["wait_ms"] = wait_ms
         rec["ring_hits"] = hits
         rec["ring_misses"] = misses
+        self._last_offload_wait_ms = wait_ms
         self.telemetry.emit("offload_staged", rec, step=self.global_steps)
         if wait_ms > 0.0:
             self.telemetry.emit(
@@ -2009,6 +2014,10 @@ class DeepSpeedEngine:
                 logger.warning(f"[stability] skipping quarantined batch "
                                f"{fp} at micro step {self.micro_steps}")
                 self._skip_micro = True
+                self._skipped_micros_step += 1
+                if (self.telemetry is not None
+                        and self.telemetry.ledger is not None):
+                    self.telemetry.ledger.note_quarantine_skip()
                 self._cached_grads = None
                 self._cached_loss = None
                 return jnp.zeros((), jnp.float32)
@@ -2374,6 +2383,16 @@ class DeepSpeedEngine:
             if self.profiler_window is not None:
                 self.profiler_window.step_end(self.global_steps)
             self._report_progress()
+        if self.telemetry is not None and self.telemetry.ledger is not None:
+            # goodput attribution: the span since the last mark belongs to
+            # this step — net of the staging stall the offload fold just
+            # measured, with the quarantined-micro share split out
+            gas = max(1, self.gradient_accumulation_steps())
+            self.telemetry.ledger.on_step(
+                self.global_steps,
+                offload_wait_s=self._last_offload_wait_ms / 1e3,
+                quarantine_frac=self._skipped_micros_step / gas)
+        self._skipped_micros_step = 0
         fault_point("train.step", step=self.global_steps)
         if self.stability is not None:
             # same seam as the preemption check below: the boundary is the
@@ -2481,6 +2500,11 @@ class DeepSpeedEngine:
                  "quarantined": len(added),
                  "count": self.stability.auto_rollbacks},
                 step=self.global_steps)
+            if self.telemetry.ledger is not None:
+                # steps (to_step, from_step] are lost work; their replay
+                # is attributed to rollback_recompute, not productive
+                self.telemetry.ledger.on_rollback(from_step,
+                                                  self.global_steps)
             self.telemetry.flush()
         # the rolled-back trajectory's cached values are meaningless now
         self._cached_loss = None
@@ -2762,7 +2786,12 @@ class DeepSpeedEngine:
                 tag = f"preempt_step{self.global_steps}"
                 self.save_checkpoint(save_dir, tag=tag)
                 # the grace window is all we have: block until durable
+                t0 = time.monotonic()
                 wait_for_finalizer(self, timeout=ftcfg.preemption_grace_s)
+                if (self.telemetry is not None
+                        and self.telemetry.ledger is not None):
+                    self.telemetry.ledger.note_ckpt_stall(
+                        time.monotonic() - t0)
                 saved_tag = tag
             except Exception as e:
                 logger.error(f"preemption checkpoint failed: {e}")
@@ -2876,8 +2905,16 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
         from deepspeed_tpu.runtime.checkpointing import save_checkpoint as _save
-        return _save(self, save_dir, tag=tag, client_state=client_state or {},
-                     save_latest=save_latest)
+        t0 = time.monotonic()
+        try:
+            return _save(self, save_dir, tag=tag,
+                         client_state=client_state or {},
+                         save_latest=save_latest)
+        finally:
+            if self.telemetry is not None and self.telemetry.ledger is not None:
+                # the blocking portion of the save (async finalize runs off
+                # the step path and is timed where it is joined)
+                self.telemetry.ledger.note_ckpt_stall(time.monotonic() - t0)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,

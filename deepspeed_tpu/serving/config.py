@@ -13,7 +13,9 @@ arena: total KV bytes = 2 * n_layer * num_blocks * block_size * kv_heads *
 head_dim * dtype_bytes.
 """
 
-from typing import Optional
+from typing import Dict, Optional
+
+from pydantic import Field
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
@@ -32,6 +34,9 @@ class DeepSpeedServingConfig(DeepSpeedConfigModel):
     max_queue: int = 1024         # waiting-queue bound; submit raises past it
     # ---- scheduling ------------------------------------------------------ #
     slo_preemption: bool = True   # higher SLO classes may evict lower ones
+    # per-class TTFT bounds (ms) for the goodput ledger's tokens-within-
+    # bound accounting; unset classes use telemetry/ledger.py defaults
+    slo_ttft_bound_ms: Dict[str, float] = Field(default_factory=dict)
     max_new_tokens_default: int = 64
     eos_token_id: Optional[int] = None
     # ---- tiered KV (serving/kv_tiering.py) -------------------------------- #

@@ -233,6 +233,17 @@ class ServingEngine:
         self._started = time.monotonic()
         self._closed = False
         self._owns_telemetry = False    # init_serving flips for dict-built hubs
+        # goodput ledger (telemetry/ledger.py): reuse the hub's ledger in
+        # serve mode — step() attributes wall time, finished requests feed
+        # the per-SLO tokens-within-TTFT-bound accounting
+        self.ledger = getattr(telemetry, "ledger", None)
+        self._restage_wait_ms = 0.0
+        if self.ledger is not None:
+            self.ledger.mode = "serve"
+            self.ledger.slo_ttft_bounds_ms.update(
+                {str(k): float(v)
+                 for k, v in (cfg.slo_ttft_bound_ms or {}).items()})
+            self.ledger.mark()
         obs = getattr(telemetry, "obs_server", None)
         if obs is not None:
             obs.add_health_check("serve_arena", self._arena_health)
@@ -249,6 +260,10 @@ class ServingEngine:
         return tr.span(name, **args) if tr is not None else nullcontext()
 
     def _emit(self, kind, payload, step=None):
+        if (self.ledger is not None and kind == "kv_restage"
+                and payload.get("ok")):
+            # exposed restage wait attributes to offload_stall on next step
+            self._restage_wait_ms += float(payload.get("wait_ms", 0.0))
         if self.telemetry is not None:
             self.telemetry.emit(kind, payload, step=step)
 
@@ -271,6 +286,10 @@ class ServingEngine:
         return out
 
     def _on_preempt(self, victim: Request):
+        if self.ledger is not None and not victim.spilled:
+            # eviction without a spill record: the prefill is recomputed
+            # from scratch on resume — those tokens are wasted work
+            self.ledger.note_wasted_prefill(victim.slo, victim.prefilled)
         self._emit("serve_preempt", {
             "rid": victim.rid, "slo": victim.slo,
             "generated": len(victim.generated),
@@ -360,6 +379,10 @@ class ServingEngine:
                 if self.registry is not None:
                     self._h_decode.observe((time.monotonic() - t_dec) * 1e3)
         self.step_count += 1
+        if self.ledger is not None:
+            self.ledger.on_step(self.step_count,
+                                offload_wait_s=self._restage_wait_ms / 1e3)
+            self._restage_wait_ms = 0.0
         stats = dict(self.sched.stats(), decode_batch=len(decode),
                      prefill_tokens=prefill_tokens,
                      tokens_generated=self.tokens_generated,
@@ -478,6 +501,9 @@ class ServingEngine:
             self.sched.finish(req)
             ttft = req.first_token_at - req.arrival
             latency = req.finished_at - req.arrival
+            if self.ledger is not None:
+                self.ledger.note_serve_request(req.slo, ttft * 1000.0,
+                                               len(req.generated))
             self._emit("serve_request", {
                 "event": "finished", "rid": req.rid, "slo": req.slo,
                 "prompt_tokens": len(req.prompt),

@@ -11,6 +11,8 @@ from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder, read_dump
 from deepspeed_tpu.telemetry.hub import (JsonlSink, MonitorSink,
                                          RingBufferSink, TelemetryHub,
                                          TelemetrySink)
+from deepspeed_tpu.telemetry.ledger import (CATEGORIES, GoodputLedger,
+                                            fold_goodput)
 from deepspeed_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                              MetricsRegistry, MetricsSink,
                                              cross_rank_snapshot,
@@ -51,6 +53,9 @@ __all__ = [
     "merge_snapshots",
     "cross_rank_snapshot",
     "render_prometheus",
+    "GoodputLedger",
+    "CATEGORIES",
+    "fold_goodput",
     "ObsServer",
     "watchdog_health_check",
     "SLORule",

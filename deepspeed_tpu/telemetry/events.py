@@ -44,13 +44,16 @@ PREFIX_HIT = "prefix_hit"          # cached prompt blocks attached copy-free
 PROGRAM_CACHE = "program_cache_evict"  # inference per-shape LRU cache eviction
 OFFLOAD_STAGED = "offload_staged"  # per-step staging fold (bytes, ring hits)
 OFFLOAD_WAIT = "offload_wait"      # blocking stall on a staged read/write
+DOWNTIME = "downtime"              # elastic-agent worker_exit -> restart gap
+GOODPUT = "goodput"                # cumulative GoodputLedger snapshot
 SCHEMA = "schema"                  # JSONL header record (written by the sink)
 
 KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
          WORKER_EXIT, CKPT_SAVED, CKPT_RETRY, CKPT_ROLLBACK, PREEMPTION,
          ANOMALY, LR_BACKOFF, AUTO_ROLLBACK, BATCH_QUARANTINED, EF_RESET,
          SERVE_REQUEST, SERVE_STEP, SERVE_PREEMPT, KV_SPILL, KV_RESTAGE,
-         PREFIX_HIT, PROGRAM_CACHE, OFFLOAD_STAGED, OFFLOAD_WAIT, SCHEMA)
+         PREFIX_HIT, PROGRAM_CACHE, OFFLOAD_STAGED, OFFLOAD_WAIT, DOWNTIME,
+         GOODPUT, SCHEMA)
 
 # Every `step` record carries at least these keys once drained.
 STEP_REQUIRED_FIELDS = (

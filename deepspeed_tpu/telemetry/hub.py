@@ -217,6 +217,10 @@ class TelemetryHub:
         self._last_snapshot_step = None
         self._last_step_mono = None
         self._last_flush_mono = time.monotonic()
+        # goodput attribution (wired up by from_config when enabled)
+        self.ledger = None               # ledger.GoodputLedger
+        self.efficiency_json_path = ""   # per-run EFFICIENCY.json artifact
+        self._goodput_final = False
 
     # -- construction ---------------------------------------------------- #
     @classmethod
@@ -254,6 +258,22 @@ class TelemetryHub:
             hub.slo_monitor = slo_mod.SLOMonitor(
                 slo_mod.rules_from_config(getattr(tcfg, "slo_rules", None)),
                 registry=hub.registry, telemetry=hub)
+            if getattr(tcfg, "goodput", True):
+                from deepspeed_tpu.telemetry.ledger import GoodputLedger
+                peak_tflops = float(
+                    getattr(tcfg, "goodput_peak_tflops_per_chip", 0.0) or 0.0)
+                hub.ledger = GoodputLedger(
+                    registry=hub.registry,
+                    hang_threshold_s=(
+                        float(getattr(tcfg, "watchdog_timeout_s", 0.0))
+                        if getattr(tcfg, "watchdog_enabled", False) else 0.0),
+                    flops_per_step=flops_fn,
+                    peak_flops_per_s=(peak_tflops * 1e12) or None)
+                path = getattr(tcfg, "efficiency_json_path", "") or ""
+                if not path and tcfg.jsonl_path:
+                    path = os.path.join(os.path.dirname(tcfg.jsonl_path),
+                                        "EFFICIENCY.json")
+                hub.efficiency_json_path = path
             if getattr(tcfg, "ops_server", False):
                 from deepspeed_tpu.telemetry.obs_server import ObsServer
                 hub.obs_server = ObsServer(
@@ -262,6 +282,8 @@ class TelemetryHub:
                     port=getattr(tcfg, "ops_port", 0),
                     slo_monitor=hub.slo_monitor)
                 hub.obs_server.add_health_check("telemetry", hub.health_check)
+                if hub.ledger is not None:
+                    hub.obs_server.goodput_fn = hub.ledger.snapshot
                 hub.obs_server.start()
         return hub
 
@@ -339,7 +361,11 @@ class TelemetryHub:
             return
         pending, self._pending = self._pending, []
         self._pending_steps = 0
-        self._drain_device()
+        # the drain exists to materialize buffered device values in step
+        # records; a window of host-side event records (worker_exit, SLO
+        # transitions, the closing goodput snapshot) must not pay a sync
+        if any(rec.get("kind") == events.STEP for rec in pending):
+            self._drain_device()
         peak = self._device_peak_bytes()
         flops = None
         if self.flops_per_step is not None:
@@ -380,6 +406,16 @@ class TelemetryHub:
             prev_comm = comm_cum
         self._window_t = prev_t
         self._window_comm = prev_comm
+
+        # one cumulative goodput snapshot rides every drain window (the
+        # close() finalization emits the authoritative last one itself)
+        if self.ledger is not None and not self._goodput_final and not any(
+                r.get("kind") == events.GOODPUT for r in out):
+            try:
+                out.append(events.make_record(events.GOODPUT,
+                                              self.ledger.snapshot()))
+            except Exception as e:
+                logger.warning(f"goodput snapshot failed: {e}")
 
         for sink in self.sinks:
             try:
@@ -426,6 +462,19 @@ class TelemetryHub:
     def close(self):
         if self.closed:
             return
+        if self.ledger is not None and not self._goodput_final:
+            # final cumulative snapshot: the same dict becomes the last
+            # `goodput` record in the JSONL AND the EFFICIENCY.json body,
+            # so the offline fold and the artifact agree exactly
+            self._goodput_final = True
+            try:
+                snap = self.ledger.snapshot()
+                self.emit(events.GOODPUT, snap)
+                if self.efficiency_json_path:
+                    self.ledger.write_efficiency_json(
+                        self.efficiency_json_path, snap=snap)
+            except Exception as e:
+                logger.warning(f"goodput finalization failed: {e}")
         self.flush()
         if self._pending:        # SLO transition events from the final flush
             self.flush()

@@ -733,6 +733,14 @@ class MetricsSink:
                                "severity": str(rec.get("severity", "fast"))}
         ).inc()
 
+    def _on_downtime(self, rec):
+        # elastic-agent restart gap: feeds the same category counter the
+        # GoodputLedger mirrors, so the agent's /metrics carries it
+        self.registry.counter(
+            "goodput_seconds_total", {"category": "downtime"}
+        ).inc(float(rec.get("downtime_s", 0.0)))
+        self.registry.counter("goodput_downtime_events_total").inc()
+
 
 _SINK_HANDLERS = {
     "step": MetricsSink._on_step,
@@ -750,6 +758,7 @@ _SINK_HANDLERS = {
     "batch_quarantined": MetricsSink._on_batch_quarantined,
     "comm_summary": MetricsSink._on_comm_summary,
     "slo_burn": MetricsSink._on_slo_burn,
+    "downtime": MetricsSink._on_downtime,
 }
 
 

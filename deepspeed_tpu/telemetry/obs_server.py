@@ -13,6 +13,9 @@ loopback unless configured otherwise.  Endpoints:
 * ``GET /slo`` — the :class:`~deepspeed_tpu.telemetry.slo.SLOMonitor`
   machine-readable verdict (``200`` when every rule is ``ok``, ``503``
   while any rule is burning).
+* ``GET /goodput`` — the live cumulative
+  :class:`~deepspeed_tpu.telemetry.ledger.GoodputLedger` snapshot
+  (category seconds, goodput fraction, conservation verdict).
 * ``POST /debug/dump`` (``GET`` accepted for curl ergonomics) — triggers
   a flight-recorder dump and returns its path.
 
@@ -42,6 +45,7 @@ class ObsServer:
         self._requested_port = int(port)
         self.flight_recorder = flight_recorder
         self.slo_monitor = slo_monitor
+        self.goodput_fn = None     # GoodputLedger.snapshot when wired
         self.prefix = prefix
         self._checks: Dict[str, Callable[[], Dict[str, Any]]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -81,6 +85,11 @@ class ObsServer:
         if self.slo_monitor is None:
             return None
         return self.slo_monitor.verdict()
+
+    def goodput_snapshot(self) -> Optional[Dict[str, Any]]:
+        if self.goodput_fn is None:
+            return None
+        return self.goodput_fn()
 
     def debug_dump(self) -> Dict[str, Any]:
         if self.flight_recorder is None:
@@ -130,6 +139,12 @@ class ObsServer:
                             self._json(404, {"error": "no SLO monitor"})
                         else:
                             self._json(200 if v["ok"] else 503, v)
+                    elif path == "/goodput":
+                        g = server.goodput_snapshot()
+                        if g is None:
+                            self._json(404, {"error": "no goodput ledger"})
+                        else:
+                            self._json(200, g)
                     elif path == "/debug/dump":
                         d = server.debug_dump()
                         self._json(200 if d["ok"] else 500, d)

@@ -161,3 +161,39 @@ def load_records(path: str):
     if not records:
         return None, f"{path}: no telemetry records (wrong file?)"
     return records, None
+
+
+# --------------------------------------------------------------------------- #
+# Uniform report finalization — one output contract for every report CLI.
+# --------------------------------------------------------------------------- #
+
+# Version of the uniform CLI envelope (tool/report_schema keys + gates→ok
+# convention), independent of each tool's own payload fields.
+REPORT_SCHEMA = 1
+
+
+def finalize_report(tool: str, report: Dict[str, Any],
+                    gates: Optional[Dict[str, Any]] = None,
+                    json_out: Optional[str] = None) -> int:
+    """Stamp, print, optionally persist a report dict; return the exit code.
+
+    The one output path shared by every report CLI (``serve_report``,
+    ``offload_audit``, ``stability_report``, ``obs_report``,
+    ``goodput_report``, ``bench_trend``): adds the uniform envelope keys
+    *into* the report (``tool``, ``report_schema`` — existing top-level
+    payload fields stay where tests and downstream autotuners expect
+    them), merges ``gates`` under ``report["gates"]`` when given, prints
+    the canonical sorted-JSON text, mirrors the *same text* to
+    ``json_out`` when set, and returns 0/1 from ``report["ok"]``
+    (missing ``ok`` means nothing was gated → 0).
+    """
+    report.setdefault("tool", tool)
+    report.setdefault("report_schema", REPORT_SCHEMA)
+    if gates is not None:
+        report.setdefault("gates", {}).update(gates)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if json_out:
+        with open(json_out, "w") as f:
+            f.write(text + "\n")
+    return 0 if report.get("ok", True) else 1

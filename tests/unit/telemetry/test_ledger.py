@@ -1,0 +1,437 @@
+"""Goodput ledger unit proof: the attribution taxonomy, the conservation
+invariant (categories sum to wall, by construction and under fabricated
+over-claims), the rollback/replay and serve token accounting, the
+cross-attempt offline fold, the registry mirroring that feeds the
+``dstpu_goodput_*`` Prometheus series, and the hub integration
+(auto-appended snapshots, the final record == ``EFFICIENCY.json``, and
+the ``/goodput`` ops endpoint)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.telemetry import events
+from deepspeed_tpu.telemetry.hub import JsonlSink, TelemetryHub
+from deepspeed_tpu.telemetry.ledger import (CATEGORIES,
+                                            DEFAULT_SLO_TTFT_BOUNDS_MS,
+                                            GoodputLedger, conservation,
+                                            fold_goodput, serve_summary)
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry, render_prometheus
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+        return self.t
+
+
+def _ledger(**kw):
+    clock = FakeClock()
+    return GoodputLedger(clock=clock, **kw), clock
+
+
+class TestAttribution:
+    def test_plain_steps_are_productive(self):
+        led, clock = _ledger()
+        for step in (1, 2, 3):
+            clock.advance(1.0)
+            led.on_step(step)
+        snap = led.snapshot()
+        assert snap["categories"]["productive"] == pytest.approx(3.0)
+        assert snap["goodput_frac"] == pytest.approx(1.0)
+        assert snap["steps"] == snap["productive_steps"] == 3
+        assert snap["conservation"]["ok"]
+
+    def test_split_order_offload_comm_quarantine(self):
+        led, clock = _ledger()
+        clock.advance(2.0)
+        # 2s span: 0.5 offload stall, 0.3 exposed comm, half the rest
+        # quarantined -> 0.6 skip, 0.6 productive
+        led.on_step(1, offload_wait_s=0.5, exposed_comm_s=0.3,
+                    quarantine_frac=0.5)
+        c = led.snapshot()["categories"]
+        assert c["offload_stall"] == pytest.approx(0.5)
+        assert c["exposed_comm"] == pytest.approx(0.3)
+        assert c["quarantine_skip"] == pytest.approx(0.6)
+        assert c["productive"] == pytest.approx(0.6)
+
+    def test_stall_claims_clamp_to_span(self):
+        led, clock = _ledger()
+        clock.advance(1.0)
+        led.on_step(1, offload_wait_s=5.0, exposed_comm_s=5.0)
+        c = led.snapshot()["categories"]
+        assert c["offload_stall"] == pytest.approx(1.0)
+        assert c["exposed_comm"] == 0.0
+        assert c["productive"] == 0.0
+        assert led.conservation()["ok"]
+
+    def test_hang_excess_above_threshold(self):
+        led, clock = _ledger(hang_threshold_s=1.0)
+        clock.advance(3.5)
+        led.on_step(1)
+        c = led.snapshot()["categories"]
+        assert c["hang"] == pytest.approx(2.5)
+        assert c["productive"] == pytest.approx(1.0)
+
+    def test_mark_skips_span_to_idle_other(self):
+        led, clock = _ledger()
+        clock.advance(10.0)       # setup/compile
+        led.mark()
+        clock.advance(1.0)
+        led.on_step(1)
+        snap = led.snapshot()
+        assert snap["categories"]["idle_other"] == pytest.approx(10.0)
+        assert snap["categories"]["productive"] == pytest.approx(1.0)
+        assert snap["conservation"]["ok"]
+
+    def test_note_advances_mark_no_double_count(self):
+        led, clock = _ledger()
+        clock.advance(1.0)
+        led.on_step(1)
+        clock.advance(2.0)        # a measured checkpoint save
+        led.note_ckpt_stall(2.0)
+        clock.advance(1.0)
+        led.on_step(2)
+        c = led.snapshot()["categories"]
+        assert c["ckpt_stall"] == pytest.approx(2.0)
+        assert c["productive"] == pytest.approx(2.0)   # NOT 4.0
+        assert led.conservation()["ok"]
+
+    def test_rollback_replay_and_lost_steps(self):
+        led, clock = _ledger()
+        for step in (1, 2, 3, 4):
+            clock.advance(1.0)
+            led.on_step(step)
+        led.on_rollback(4, 2)
+        for step in (3, 4):       # replay
+            clock.advance(1.0)
+            led.on_step(step)
+        clock.advance(1.0)
+        led.on_step(5)            # past the replay window again
+        snap = led.snapshot()
+        assert led.lost_work_steps == 2
+        assert snap["categories"]["rollback_recompute"] == pytest.approx(2.0)
+        assert snap["categories"]["productive"] == pytest.approx(5.0)
+        assert snap["productive_steps"] == 5
+        assert snap["rollbacks"] == 1
+        assert snap["goodput_frac"] < 1.0
+        assert snap["conservation"]["ok"]
+
+    def test_downtime_and_quarantine_notes(self):
+        led, clock = _ledger()
+        clock.advance(3.0)
+        led.note_downtime(3.0)
+        led.note_quarantine_skip()              # counted, no seconds
+        clock.advance(0.5)
+        led.note_quarantine_skip(0.5)           # measured out-of-step
+        snap = led.snapshot()
+        assert snap["categories"]["downtime"] == pytest.approx(3.0)
+        assert snap["categories"]["quarantine_skip"] == pytest.approx(0.5)
+        assert snap["quarantine_skips"] == 2
+        assert snap["conservation"]["ok"]
+
+
+class TestConservation:
+    def test_every_category_keyed_and_sums_to_wall(self):
+        led, clock = _ledger(hang_threshold_s=0.5)
+        clock.advance(2.0)
+        led.on_step(1, offload_wait_s=0.2, exposed_comm_s=0.1,
+                    quarantine_frac=0.25)
+        clock.advance(1.0)
+        led.note_ckpt_stall(1.0)
+        clock.advance(4.0)        # unclaimed -> idle_other
+        snap = led.snapshot()
+        assert set(snap["categories"]) == set(CATEGORIES)
+        assert snap["conservation"]["frac_err"] == pytest.approx(0.0)
+        assert sum(snap["categories"].values()) == pytest.approx(
+            snap["wall_s"])
+
+    def test_fabricated_overclaim_fails_conservation(self):
+        # noting seconds that never elapsed on the clock is
+        # mis-instrumentation, and the invariant must catch it
+        led, clock = _ledger()
+        clock.advance(1.0)
+        led.on_step(1)
+        led.note_ckpt_stall(5.0)          # nothing actually elapsed
+        verdict = led.conservation()
+        assert not verdict["ok"]
+        assert verdict["sum_s"] > verdict["wall_s"]
+
+    def test_conservation_eps_is_fractional(self):
+        snap = {"wall_s": 100.0,
+                "categories": {"productive": 100.5}}
+        assert conservation(snap, eps=0.01)["ok"]
+        assert not conservation(snap, eps=0.001)["ok"]
+
+
+class TestServeGoodput:
+    def test_ttft_bound_splits_tokens(self):
+        led, _ = _ledger(mode="serve")
+        led.note_serve_request("interactive", 100.0, 10)    # in bound
+        led.note_serve_request("interactive", 900.0, 5)     # late (>500ms)
+        led.note_serve_request("batch", 20000.0, 7)         # in bound
+        led.note_wasted_prefill("interactive", 3)
+        snap = led.snapshot()
+        serve = snap["serve"]
+        assert serve["tokens_in_bound"] == 17
+        assert serve["tokens_late"] == 5
+        assert serve["wasted_prefill_tokens"] == 3
+        assert serve["goodput_tokens_frac"] == pytest.approx(17 / 25)
+        by = serve["by_slo"]["interactive"]
+        assert by["finished"] == 2 and by["wasted_prefill_tokens"] == 3
+
+    def test_bounds_overridable_unknown_slo_uses_standard(self):
+        led, _ = _ledger(mode="serve")
+        led.slo_ttft_bounds_ms["gold"] = 50.0
+        led.note_serve_request("gold", 60.0, 4)             # late vs 50ms
+        led.note_serve_request("mystery", 1500.0, 6)        # standard bound
+        serve = led.snapshot()["serve"]
+        assert serve["by_slo"]["gold"]["tokens_late"] == 4
+        assert serve["by_slo"]["mystery"]["tokens_in_bound"] == 6
+        assert DEFAULT_SLO_TTFT_BOUNDS_MS["standard"] == 2000.0
+
+    def test_serve_summary_empty_frac_is_none(self):
+        assert serve_summary({})["goodput_tokens_frac"] is None
+
+
+class TestFold:
+    def _snap_rec(self, led):
+        return events.make_record(events.GOODPUT, led.snapshot())
+
+    def test_fold_two_attempts_plus_downtime_conserves(self):
+        led1, c1 = _ledger(run_id="a1")
+        c1.advance(2.0)
+        led1.on_step(1)
+        led2, c2 = _ledger(run_id="a2")
+        c2.advance(3.0)
+        led2.on_step(1)
+        recs = [self._snap_rec(led1), self._snap_rec(led2),
+                events.make_record(events.DOWNTIME, {"downtime_s": 4.0})]
+        fold = fold_goodput(recs)
+        assert fold["attempts"] == 2
+        assert fold["run_ids"] == ["a1", "a2"]
+        assert fold["wall_s"] == pytest.approx(9.0)
+        assert fold["categories"]["downtime"] == pytest.approx(4.0)
+        assert fold["categories"]["productive"] == pytest.approx(5.0)
+        assert fold["goodput_frac"] == pytest.approx(5.0 / 9.0)
+        assert fold["downtime_events"] == 1
+        assert fold["conservation"]["ok"]
+
+    def test_last_cumulative_snapshot_per_attempt_wins(self):
+        led, clock = _ledger(run_id="a1")
+        clock.advance(1.0)
+        led.on_step(1)
+        early = self._snap_rec(led)
+        clock.advance(1.0)
+        led.on_step(2)
+        late = self._snap_rec(led)
+        fold = fold_goodput([early, late])
+        assert fold["attempts"] == 1
+        assert fold["steps"] == 2
+        assert fold["wall_s"] == pytest.approx(2.0)
+
+    def test_fold_sums_counters_and_merges_serve(self):
+        led1, c1 = _ledger(run_id="a1", mode="serve")
+        c1.advance(1.0)
+        led1.on_step(1)
+        led1.on_rollback(3, 1)
+        led1.note_serve_request("standard", 100.0, 4)
+        led2, c2 = _ledger(run_id="a2", mode="serve")
+        c2.advance(1.0)
+        led2.on_step(1)
+        led2.note_serve_request("standard", 9000.0, 2)
+        fold = fold_goodput([self._snap_rec(led1), self._snap_rec(led2)])
+        assert fold["mode"] == "serve"
+        assert fold["lost_work_steps"] == 2 and fold["rollbacks"] == 1
+        by = fold["serve"]["by_slo"]["standard"]
+        assert by["finished"] == 2
+        assert by["tokens_in_bound"] == 4 and by["tokens_late"] == 2
+
+    def test_fold_without_goodput_records_is_none(self):
+        assert fold_goodput([{"kind": "step", "step": 1}]) is None
+        assert fold_goodput([]) is None
+
+
+class TestRegistryMirror:
+    def test_counters_and_gauges_render_prometheus(self):
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        led = GoodputLedger(registry=reg, clock=clock,
+                            flops_per_step=1e9, peak_flops_per_s=1e9)
+        clock.advance(2.0)
+        led.on_step(1, offload_wait_s=0.5)
+        led.on_rollback(1, 0)
+        snap = reg.snapshot()
+        text = render_prometheus(snap)
+        assert 'dstpu_goodput_seconds_total{category="productive"} 1.5' in text
+        assert 'dstpu_goodput_seconds_total{category="offload_stall"} 0.5' \
+            in text
+        assert "dstpu_goodput_steps_total 1" in text
+        assert "dstpu_goodput_lost_work_steps 1" in text
+        assert "dstpu_goodput_frac" in text
+        assert "dstpu_goodput_mfu" in text
+        assert "dstpu_goodput_wall_seconds" in text
+
+    def test_mfu_derivation_and_none_without_inputs(self):
+        clock = FakeClock()
+        led = GoodputLedger(clock=clock, flops_per_step=2e12,
+                            peak_flops_per_s=1e12)
+        clock.advance(4.0)
+        led.on_step(1)
+        clock.advance(4.0)
+        led.on_step(2)
+        # 2 productive steps x 2e12 FLOPs over 8s x 1e12 peak = 0.5
+        assert led.snapshot()["mfu"] == pytest.approx(0.5)
+        bare, c2 = _ledger()
+        c2.advance(1.0)
+        bare.on_step(1)
+        assert bare.snapshot()["mfu"] is None
+
+
+class TestHubIntegration:
+    def _hub(self, tmp_path, **tele_kw):
+        from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
+        jsonl = tmp_path / "telemetry.jsonl"
+        cfg = DeepSpeedTelemetryConfig(enabled=True, jsonl_path=str(jsonl),
+                                       flush_every=2, **tele_kw)
+        return TelemetryHub.from_config(cfg), jsonl
+
+    def test_from_config_builds_ledger_and_goodput_off_disables(self,
+                                                                tmp_path):
+        hub, _ = self._hub(tmp_path)
+        assert hub.ledger is not None
+        assert hub.efficiency_json_path.endswith("EFFICIENCY.json")
+        hub.close()
+        hub2, _ = self._hub(tmp_path, goodput=False)
+        assert hub2.ledger is None
+        hub2.close()
+
+    def test_flush_auto_appends_cumulative_snapshot(self, tmp_path):
+        hub, jsonl = self._hub(tmp_path)
+        hub.ledger.on_step(1)
+        hub.emit(events.CKPT_SAVED, {"tag": "t1"})
+        hub.flush()
+        recs = [json.loads(l) for l in open(jsonl) if l.strip()]
+        gp = [r for r in recs if r.get("kind") == "goodput"]
+        assert len(gp) == 1 and gp[0]["steps"] == 1
+        hub.close()
+
+    def test_efficiency_json_equals_final_goodput_record(self, tmp_path):
+        hub, jsonl = self._hub(tmp_path)
+        hub.ledger.on_step(1)
+        hub.emit(events.CKPT_SAVED, {"tag": "t1"})
+        hub.flush()
+        hub.close()
+        doc = json.load(open(tmp_path / "EFFICIENCY.json"))
+        assert doc["source"] == "live" and "generated_unix" in doc
+        recs = [json.loads(l) for l in open(jsonl) if l.strip()]
+        gp = [r for r in recs if r.get("kind") == "goodput"]
+        final = gp[-1]
+        for key in ("wall_s", "categories", "steps", "goodput_frac",
+                    "run_id", "conservation"):
+            assert doc["ledger"][key] == final[key]
+        # the offline fold of the file agrees with the artifact
+        fold = fold_goodput(recs)
+        assert fold["conservation"]["ok"]
+        assert fold["categories"] == pytest.approx(
+            {**final["categories"]})
+
+    def test_no_goodput_record_after_close(self, tmp_path):
+        hub, jsonl = self._hub(tmp_path)
+        hub.ledger.on_step(1)
+        hub.close()
+        n = sum(1 for l in open(jsonl) if l.strip()
+                and json.loads(l).get("kind") == "goodput")
+        assert n == 1                      # exactly the final one
+
+    def test_downtime_events_feed_metrics_sink(self, tmp_path):
+        from deepspeed_tpu.telemetry.metrics import MetricsSink
+        reg = MetricsRegistry()
+        sink = MetricsSink(reg)
+        sink.write([events.make_record(events.DOWNTIME,
+                                       {"downtime_s": 2.5}),
+                    events.make_record(events.DOWNTIME,
+                                       {"downtime_s": 1.5})])
+        text = render_prometheus(reg.snapshot())
+        assert 'dstpu_goodput_seconds_total{category="downtime"} 4' in text
+        assert "dstpu_goodput_downtime_events_total 2" in text
+
+
+class TestObsEndpoint:
+    def test_goodput_route_serves_snapshot_and_404_without_ledger(self):
+        from deepspeed_tpu.telemetry.obs_server import ObsServer
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        led = GoodputLedger(registry=reg, clock=clock)
+        clock.advance(1.0)
+        led.on_step(1)
+        srv = ObsServer(registry=reg, port=0)
+        srv.goodput_fn = led.snapshot
+        srv.start()
+        try:
+            with urllib.request.urlopen(srv.url + "/goodput",
+                                        timeout=5) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["run_id"] == led.run_id
+            assert doc["categories"]["productive"] == pytest.approx(1.0)
+            assert doc["conservation"]["ok"]
+            # endpoint view agrees with an offline fold of the same state
+            fold = fold_goodput([events.make_record(events.GOODPUT,
+                                                    led.snapshot())])
+            assert fold["categories"]["productive"] == pytest.approx(
+                doc["categories"]["productive"])
+            srv.goodput_fn = None
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/goodput", timeout=5)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+
+class TestAgentDowntimeEvent:
+    def test_restart_gap_emits_downtime_record(self, tmp_path):
+        import sys
+
+        from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                            WorkerSpec)
+        marker = tmp_path / "attempt"
+        body = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+            "open(m, 'w').write(str(n + 1))\n"
+            "sys.exit(0 if n >= 1 else 143)\n")
+        script = tmp_path / "worker.py"
+        script.write_text(body)
+        jsonl = tmp_path / "agent.jsonl"
+        hub = TelemetryHub(sinks=[JsonlSink(str(jsonl))], flush_every=0,
+                           sync_fn=lambda: None, memory_stats_fn=lambda: {})
+        agent = DSElasticAgent(WorkerSpec([sys.executable, str(script)]),
+                               monitor_interval=0.1, telemetry=hub,
+                               sleep_fn=lambda s: None)
+        assert agent.run() == 0
+        hub.close()
+        recs = [json.loads(l) for l in open(jsonl) if l.strip()]
+        downs = [r for r in recs if r.get("kind") == "downtime"]
+        assert len(downs) == 1
+        d = downs[0]
+        assert d["reason"] == "preemption" and d["exit_code"] == 143
+        assert d["downtime_s"] > 0.0
+        assert d["preemption_count"] == 1
+        # the fold bridges the gap into the downtime category
+        led, clock = _ledger(run_id="a1")
+        clock.advance(1.0)
+        led.on_step(1)
+        recs.append(events.make_record(events.GOODPUT, led.snapshot()))
+        fold = fold_goodput(recs)
+        assert fold["categories"]["downtime"] == pytest.approx(
+            d["downtime_s"])
+        assert fold["conservation"]["ok"]

@@ -1,0 +1,242 @@
+"""End-to-end goodput-ledger conservation proof, in the
+test_stability_e2e subprocess style but supervised by the elastic agent:
+a worker trains on 8 forced-host devices while a ``DS_FAULT_PLAN``
+SIGTERMs it mid-run (scheduler preemption) and a fingerprint-matched
+NaN plan forces the stability ladder through an auto-rollback first.
+The agent records the worker_exit→restart gap as a ``downtime`` event
+into the SAME telemetry JSONL, the restarted attempt resumes from the
+preemption checkpoint and finishes clean, and the folded cross-attempt
+ledger must conserve wall time within 1% while attributing real seconds
+to ``rollback_recompute`` and ``downtime`` — with ``lost_work_steps``
+equal to exactly the steps the rollback replayed.  The per-run
+``EFFICIENCY.json`` artifact must agree with the final live snapshot,
+and ``tools/goodput_report.py`` must gate the run both ways."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, WorkerSpec
+from deepspeed_tpu.telemetry import stats
+from deepspeed_tpu.telemetry.hub import JsonlSink, TelemetryHub
+from deepspeed_tpu.telemetry.ledger import fold_goodput
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HIDDEN = 8
+BATCH = 8
+TARGET_STEPS = 12
+PREEMPT_STEP = 11   # only ever reached AFTER the rollback replay
+
+# Same data scheme as test_stability_e2e: a 4-batch clean cycle with one
+# fixed poison batch at data positions 6..9.  On a fresh start (no
+# checkpoint yet) the worker appends a fingerprint-matched NaN rule to
+# the env-installed DS_FAULT_PLAN, so the ladder walks to an
+# auto-rollback (to step 4) and the quarantined replay carries the run
+# past the poison; the env plan's SIGTERM at step 11 then preempts the
+# process after the replay completed.  The restarted attempt sees the
+# preemption checkpoint, skips the poison plan, resumes, and finishes.
+WORKER = textwrap.dedent("""\
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel
+    from deepspeed_tpu.testing import fault_injection as fi
+
+    save_dir, jsonl, eff = sys.argv[1], sys.argv[2], sys.argv[3]
+    fresh = not os.path.isdir(save_dir)
+    model = SimpleModel(hidden_dim={hidden})
+    params = model.init_params(jax.random.key(0))
+    config = {{
+        "train_batch_size": {batch},
+        "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+        "checkpoint": {{"engine": "local"}},
+        "telemetry": {{"enabled": True, "jsonl_path": jsonl,
+                       "flush_every": 2, "efficiency_json_path": eff}},
+        "stability": {{"enabled": True, "warmup_steps": 2,
+                       "ema_alpha": 0.2, "grad_spike_factor": 1e6,
+                       "loss_spike_zscore": 1e6, "lr_backoff_after": 2,
+                       "lr_backoff_factor": 0.5, "rollback_after": 3,
+                       "max_auto_rollbacks": 2}},
+        "fault_tolerance": {{"preemption_enabled": True,
+                             "preemption_save_dir": save_dir,
+                             "preemption_grace_s": 60.0}},
+    }}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config)
+
+    rng = np.random.default_rng(0)
+    clean = [(rng.standard_normal(({batch}, {hidden})).astype(np.float32),
+              np.zeros(({batch},), np.int32)) for _ in range(4)]
+    poison = (np.full(({batch}, {hidden}), 0.5, np.float32),
+              np.zeros(({batch},), np.int32))
+    if fresh:
+        inj = fi.get_injector()   # loads the DS_FAULT_PLAN sigterm rule
+        inj.rules.append(fi.FaultRule(
+            {{"site": "train.loss", "action": "nan", "on_hit": 1,
+              "times": 10000,
+              "match": {{"fp": engine.stability.fingerprint(poison)}}}}))
+    else:
+        fi.install_plan([])       # resumed attempt runs fault-free
+        engine.load_checkpoint(save_dir)
+        print("RESUMED", engine.global_steps, flush=True)
+
+    def batch_for(pos):
+        return poison if 6 <= pos < 10 else clean[pos % 4]
+
+    last_saved, it = -1, 0
+    while engine.global_steps < {target} and it < 80:
+        it += 1
+        x, y = batch_for(engine.micro_steps)
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        if engine.global_steps != last_saved and engine.global_steps <= 4:
+            engine.save_checkpoint(save_dir)
+            last_saved = engine.global_steps
+    engine.close()
+    print("WORKER_DONE", engine.global_steps, flush=True)
+""").format(repo=REPO_ROOT, hidden=HIDDEN, batch=BATCH,
+            target=TARGET_STEPS)
+
+SIGTERM_PLAN = json.dumps([
+    {"site": "train.step", "action": "sigterm", "on_hit": 1,
+     "match": {"step": PREEMPT_STEP}},
+])
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load(jsonl):
+    records, err = stats.load_records(str(jsonl))
+    assert err is None, err
+    return records
+
+
+def _records(jsonl, kind):
+    return [r for r in _load(jsonl) if r.get("kind") == kind]
+
+
+@pytest.fixture(scope="module")
+def supervised_run(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("goodput_e2e")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    save_dir = tmp_path / "ckpt"
+    jsonl = tmp_path / "telemetry.jsonl"
+    eff = tmp_path / "EFFICIENCY.json"
+
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "DS_FAULT_PLAN": SIGTERM_PLAN,
+    }
+    hub = TelemetryHub(sinks=[JsonlSink(str(jsonl))], flush_every=0,
+                       sync_fn=lambda: None, memory_stats_fn=lambda: {})
+    agent = DSElasticAgent(
+        WorkerSpec([sys.executable, str(script), str(save_dir),
+                    str(jsonl), str(eff)], env=env),
+        max_restarts=3, monitor_interval=0.2, telemetry=hub,
+        sleep_fn=lambda s: None)
+    rc = agent.run()
+    hub.close()
+    return tmp_path, agent, rc, jsonl, eff
+
+
+class TestGoodputEndToEnd:
+    def test_preempted_run_restarts_and_finishes(self, supervised_run):
+        _, agent, rc, jsonl, _ = supervised_run
+        assert rc == 0
+        assert agent.preemption_count == 1
+        assert agent.restart_count == 0      # preemption burns no budget
+
+        # attempt 1 answered the SIGTERM with a final checkpoint...
+        exits = [r for r in _records(jsonl, "preemption")
+                 if r.get("phase") == "exit"]
+        assert len(exits) == 1 and exits[0]["saved"] is True
+        assert exits[0]["step"] == PREEMPT_STEP
+        # ...after the ladder had already rolled back and quarantined
+        rollbacks = _records(jsonl, "auto_rollback")
+        assert len(rollbacks) == 1 and rollbacks[0]["to_step"] == 4
+
+        # the agent bridged the gap with a structured downtime event
+        downs = _records(jsonl, "downtime")
+        assert len(downs) == 1
+        assert downs[0]["reason"] == "preemption"
+        assert downs[0]["exit_code"] == 143
+        assert downs[0]["downtime_s"] > 0.0
+
+    def test_fold_conserves_and_attributes_the_loss(self, supervised_run):
+        _, _, rc, jsonl, _ = supervised_run
+        assert rc == 0
+        fold = fold_goodput(_load(jsonl))
+        assert fold is not None
+        assert fold["attempts"] == 2
+        assert fold["downtime_events"] == 1
+
+        # conservation: every second of both attempts plus the restart
+        # gap is accounted for, within 1%
+        cons = fold["conservation"]
+        assert cons["ok"], cons
+        assert cons["frac_err"] <= 0.01
+
+        # the run was NOT all goodput: real seconds were lost to the
+        # rollback replay and the restart gap, and the ledger says where
+        cats = fold["categories"]
+        assert cats["rollback_recompute"] > 0.0
+        assert cats["downtime"] > 0.0
+        assert 0.0 < fold["goodput_frac"] < 1.0
+
+        # lost work == exactly the steps the rollback replayed
+        rollbacks = _records(jsonl, "auto_rollback")
+        replayed = sum(r["from_step"] - r["to_step"] for r in rollbacks)
+        assert replayed > 0
+        assert fold["lost_work_steps"] == replayed
+        assert fold["rollbacks"] == len(rollbacks)
+        assert fold["quarantine_skips"] > 0
+
+    def test_efficiency_artifact_agrees_with_live_ledger(
+            self, supervised_run):
+        _, _, rc, jsonl, eff = supervised_run
+        assert rc == 0
+        with open(eff) as f:
+            doc = json.load(f)
+        assert doc["source"] == "live"
+        led = doc["ledger"]
+        # the artifact is the final attempt's closing snapshot: byte-for
+        # -byte the last goodput record that run emitted to the JSONL
+        finals = [r for r in _records(jsonl, "goodput")
+                  if r["run_id"] == led["run_id"]]
+        assert finals, "artifact run_id missing from the JSONL"
+        last = finals[-1]
+        for key, val in led.items():
+            assert last[key] == val, key
+
+    def test_report_tool_gates_the_run(self, supervised_run):
+        tmp_path, _, rc, jsonl, eff = supervised_run
+        assert rc == 0
+        tool = _tool("goodput_report")
+        out = tmp_path / "report.json"
+        # permissive: the fold conserves, so the default gate passes
+        assert tool.main([str(jsonl), "--json", str(out)]) == 0
+        rep = json.loads(out.read_text())
+        assert rep["tool"] == "goodput_report"
+        assert rep["gates"]["max_conservation_err"]["ok"] is True
+        # strict: a lossy run must fail a 99%-goodput bar
+        assert tool.main([str(jsonl), "--min-goodput-frac", "0.99"]) == 1
+        # and the artifact is scoreable on its own
+        assert tool.main([str(eff)]) == 0

@@ -129,6 +129,35 @@ class TestZeroSyncPass:
             assert ("deepspeed_tpu/telemetry/metrics.py", scope) in scopes
         assert ("deepspeed_tpu/telemetry/slo.py", "evaluate") in scopes
 
+    def test_ledger_hot_path_scopes_are_guarded(self):
+        """The goodput ledger's per-step attribution (on_step) and its
+        registry mirror (_acc) are in the checked-scope roster."""
+        scopes = set(zero_sync.CHECKED_SCOPES)
+        for scope in ("on_step", "_acc"):
+            assert ("deepspeed_tpu/telemetry/ledger.py", scope) in scopes
+
+    def test_seeded_sync_in_ledger_hot_path_is_flagged(self, tmp_path):
+        """A seeded violation in an on_step-style attribution method —
+        coercing a possibly-traced loss to book a category — is caught."""
+        sf, _ = _scan(tmp_path, (
+            "class Ledger:\n"
+            "    def on_step(self, step, loss):\n"
+            "        span = float(loss)\n"
+            "        self._cats['productive'] += span.item()\n"))
+        msgs = [m for _, m in zero_sync.scope_violations(sf, "on_step")]
+        assert len(msgs) == 2
+        assert any("float()" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+
+    def test_live_ledger_hot_path_is_clean(self):
+        """The real ledger.py on_step/_acc pass the zero-sync check with
+        no pragmas — the hot path stays coercion-free by construction."""
+        ctx = core.Context()
+        sf = ctx.scan("deepspeed_tpu/telemetry/ledger.py",
+                      for_pass="zero-sync")
+        for scope in ("on_step", "_acc"):
+            assert list(zero_sync.scope_violations(sf, scope)) == []
+
     def test_seeded_sync_in_metrics_hot_path_is_flagged(self, tmp_path):
         """A seeded violation in a registry-style observe() — somebody
         handing a device value straight to a histogram — is caught."""

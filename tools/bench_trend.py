@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Cross-round bench trend over the driver's ``BENCH_r{N}.json`` ledger.
+
+Each bench round leaves a ``BENCH_r{N}.json`` artifact ({n, cmd, rc,
+tail, parsed}); ``parsed`` is the headline metric line — higher-better
+``value`` plus ``vs_baseline`` — or an error/degraded stamp when the
+round could not produce a real number.  This tool folds the usable
+rounds into a trend report and gates the newest one against regression.
+
+Usable means: ``rc == 0``, ``parsed`` carries a numeric ``value``, and
+the round is not stamped ``degraded`` (off-TPU artifact reruns stamp
+themselves so they are never mistaken for a real regression).  Excluded
+rounds are listed with reasons, never silently dropped.  The trend is
+computed within the newest round's headline metric name — a bench
+suite whose headline changed starts a fresh trend.
+
+Usage::
+
+    python tools/bench_trend.py [DIR] [--max-regression X] [--json OUT]
+
+Exit 0 when the newest usable round is within ``--max-regression``
+(default 0.1 = 10%) of both the previous usable round and the best
+usable round; 1 on regression; 2 when no usable rounds exist.
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+
+def _load_stats():
+    """Shared report finalizer (telemetry/stats.py), loaded by file path
+    so the tool keeps its no-jax property; package import is the
+    fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", "stats.py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_stats", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    from deepspeed_tpu.telemetry import stats
+    return stats
+
+
+_stats = _load_stats()
+
+_ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def load_rounds(directory):
+    """→ (usable rounds ascending by n, exclusions).  A usable round is
+    {n, path, metric, value, vs_baseline}; an exclusion is
+    {n, path, reason}."""
+    entries = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for name in sorted(names):
+        m = _ROUND_RE.match(name)
+        if not m:
+            continue
+        entries.append((int(m.group(1)), os.path.join(directory, name)))
+    usable, excluded = [], []
+    for n, path in sorted(entries):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            excluded.append({"n": n, "path": path,
+                             "reason": f"unreadable: {e}"})
+            continue
+        parsed = doc.get("parsed")
+        rc = doc.get("rc")
+        if rc != 0:
+            excluded.append({"n": n, "path": path, "reason": f"rc={rc}"})
+            continue
+        if not isinstance(parsed, dict):
+            excluded.append({"n": n, "path": path, "reason": "no parsed "
+                             "headline"})
+            continue
+        if parsed.get("degraded"):
+            excluded.append({"n": n, "path": path,
+                             "reason": "degraded: %s" % parsed.get(
+                                 "degraded_reason", "stamped degraded")})
+            continue
+        if not isinstance(parsed.get("value"), (int, float)):
+            excluded.append({"n": n, "path": path,
+                             "reason": "no numeric value"})
+            continue
+        usable.append({"n": n, "path": path,
+                       "metric": str(parsed.get("metric", "?")),
+                       "value": float(parsed["value"]),
+                       "vs_baseline": parsed.get("vs_baseline")})
+    return usable, excluded
+
+
+def trend(usable, max_regression):
+    """Fold usable rounds into the trend body (newest metric only)."""
+    latest = usable[-1]
+    series = [u for u in usable if u["metric"] == latest["metric"]]
+    values = [u["value"] for u in series]
+    best = max(values)
+    prev = series[-2]["value"] if len(series) > 1 else None
+    floor_prev = (prev * (1.0 - max_regression)
+                  if prev is not None else None)
+    floor_best = best * (1.0 - max_regression)
+    regressed = ((prev is not None and latest["value"] < floor_prev)
+                 or latest["value"] < floor_best)
+    return {
+        "metric": latest["metric"],
+        "latest_round": latest["n"],
+        "latest_value": latest["value"],
+        "previous_value": prev,
+        "best_value": best,
+        "best_round": series[values.index(best)]["n"],
+        "rounds_in_series": [u["n"] for u in series],
+        "delta_vs_previous": (round(latest["value"] / prev - 1.0, 4)
+                              if prev else None),
+        "delta_vs_best": (round(latest["value"] / best - 1.0, 4)
+                          if best else None),
+        "regressed": regressed,
+    }
+
+
+def main(argv=None) -> int:
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+    ap = argparse.ArgumentParser(
+        description="Cross-round bench trend over BENCH_r{N}.json")
+    ap.add_argument("directory", nargs="?", default=os.path.abspath(here),
+                    help="directory holding BENCH_r{N}.json (default: "
+                         "repo root)")
+    ap.add_argument("--max-regression", type=float, default=0.1,
+                    help="fail (exit 1) if the newest usable value falls "
+                         "more than this fraction below the previous or "
+                         "best usable round (default 0.1)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    usable, excluded = load_rounds(args.directory)
+    if not usable:
+        print(json.dumps({"error": f"{args.directory}: no usable "
+                          "BENCH_r*.json rounds",
+                          "excluded": excluded}), file=sys.stderr)
+        return 2
+
+    report = {
+        "directory": args.directory,
+        "rounds_usable": len(usable),
+        "rounds_excluded": len(excluded),
+        "excluded": excluded,
+        "usable": usable,
+        **trend(usable, args.max_regression),
+    }
+    gates = {
+        "max_regression": {
+            "limit": args.max_regression,
+            "value": report["delta_vs_best"],
+            "ok": not report["regressed"],
+        },
+    }
+    report["ok"] = all(g["ok"] for g in gates.values())
+    return _stats.finalize_report("bench_trend", report, gates=gates,
+                                  json_out=args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,10 @@ CHECKED_SCOPES: Sequence[Tuple[str, Optional[str]]] = (
     ("deepspeed_tpu/telemetry/metrics.py", "set"),
     ("deepspeed_tpu/telemetry/metrics.py", "observe"),
     ("deepspeed_tpu/telemetry/slo.py", "evaluate"),
+    # goodput ledger hot path: on_step runs at every step boundary with
+    # host floats only; _acc feeds the mirror counters.
+    ("deepspeed_tpu/telemetry/ledger.py", "on_step"),
+    ("deepspeed_tpu/telemetry/ledger.py", "_acc"),
 )
 
 _NUMPY_MODULES = ("np", "numpy")

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Goodput & efficiency report — the autotuner-scorable view of a run.
+
+Folds the ``goodput``/``downtime`` records of a telemetry JSONL set
+(``telemetry/ledger.py:fold_goodput`` — one cumulative snapshot per
+attempt, elastic-agent downtime events bridging the restart gaps) into
+the run-level attribution report, or reads a per-run ``EFFICIENCY.json``
+artifact directly.  Same family as ``tools/serve_report.py`` /
+``stability_report.py``: forensics over run artifacts, no jax required.
+
+Usage::
+
+    python tools/goodput_report.py TELEMETRY_JSONL_OR_EFFICIENCY_JSON
+        [--min-goodput-frac X] [--max-lost-steps N]
+        [--max-conservation-err X] [--json OUT]
+
+The conservation gate always runs: the category seconds must sum to the
+wall time within ``--max-conservation-err`` (fractional, default 0.01) —
+a ledger that does not conserve is mis-instrumented and must not be
+scored.  ``--min-goodput-frac`` fails (exit 1) when productive wall
+falls below the bound; ``--max-lost-steps`` fails when rollbacks
+discarded more steps than allowed.  Exit 2 on usage errors (unreadable
+file, no goodput records).
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(name):
+    """Load a telemetry module by file path so the tool keeps its no-jax
+    property; package import is the fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", name + ".py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_" + name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    import importlib
+    return importlib.import_module("deepspeed_tpu.telemetry." + name)
+
+
+_stats = _load("stats")
+_ledger = _load("ledger")
+
+load_records = _stats.load_records
+fold_goodput = _ledger.fold_goodput
+
+
+def load_report(path):
+    """→ (ledger-shaped dict, source string, error or None).
+
+    Accepts either a telemetry JSONL set (folded across attempts) or an
+    ``EFFICIENCY.json`` artifact (its ``ledger`` document used as-is —
+    the artifact IS the final goodput record of its run, so both paths
+    agree by construction)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = None
+    if isinstance(doc, dict) and "ledger" in doc:
+        led = doc["ledger"]
+        if not isinstance(led, dict) or "categories" not in led:
+            return None, None, f"{path}: malformed EFFICIENCY.json artifact"
+        return led, "artifact", None
+    records, err = load_records(path)
+    if err:
+        return None, None, err
+    led = fold_goodput(records)
+    if led is None:
+        return None, None, (f"{path}: no goodput records (was the run "
+                            "started with telemetry.goodput enabled?)")
+    return led, "jsonl", None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Goodput attribution report over telemetry JSONL "
+                    "or EFFICIENCY.json")
+    ap.add_argument("path", help="telemetry JSONL file or EFFICIENCY.json")
+    ap.add_argument("--min-goodput-frac", type=float, default=None,
+                    help="fail (exit 1) if productive/wall falls below this")
+    ap.add_argument("--max-lost-steps", type=int, default=None,
+                    help="fail (exit 1) if rollbacks discarded more steps")
+    ap.add_argument("--max-conservation-err", type=float, default=0.01,
+                    help="fail (exit 1) if |sum(categories) - wall| exceeds "
+                         "this fraction of wall (always gated)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    led, source, err = load_report(args.path)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    report = {"path": args.path, "source": source, **led}
+    # re-verdict at the gate's epsilon (the stored verdict may have used
+    # a different one)
+    cons = _ledger.conservation(led, eps=args.max_conservation_err)
+    report["conservation"] = cons
+
+    gates = {
+        "max_conservation_err": {
+            "limit": args.max_conservation_err,
+            "value": cons["frac_err"],
+            "ok": cons["ok"],
+        },
+    }
+    if args.min_goodput_frac is not None:
+        val = report.get("goodput_frac")
+        gates["min_goodput_frac"] = {
+            "limit": args.min_goodput_frac,
+            "value": val,
+            "ok": val is not None and val >= args.min_goodput_frac,
+        }
+    if args.max_lost_steps is not None:
+        val = int(report.get("lost_work_steps", 0))
+        gates["max_lost_steps"] = {
+            "limit": args.max_lost_steps,
+            "value": val,
+            "ok": val <= args.max_lost_steps,
+        }
+    report["ok"] = all(g["ok"] for g in gates.values())
+    return _stats.finalize_report("goodput_report", report, gates=gates,
+                                  json_out=args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

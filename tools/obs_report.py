@@ -158,12 +158,8 @@ def main(argv=None) -> int:
     }
     report["ok"] = (verdict["ok"] and verdict["burn_events"] == 0
                     and not violated)
-    text = json.dumps(report, indent=2, sort_keys=True)
-    print(text)
-    if args.json_out:
-        with open(args.json_out, "w") as f:
-            f.write(text + "\n")
-    return 0 if report["ok"] else 1
+    return _stats.finalize_report("obs_report", report,
+                                  json_out=args.json_out)
 
 
 if __name__ == "__main__":

@@ -145,14 +145,21 @@ def main(argv=None) -> int:
         "min_hit_rate": args.min_hit_rate,
         **audit(staged, step_ms),
     }
-    report["ok"] = (report["stall_frac"] <= args.max_stall_frac
-                    and report["hit_rate"] >= args.min_hit_rate)
-    text = json.dumps(report, indent=2, sort_keys=True)
-    print(text)
-    if args.json_out:
-        with open(args.json_out, "w") as f:
-            f.write(text + "\n")
-    return 0 if report["ok"] else 1
+    gates = {
+        "max_stall_frac": {
+            "limit": args.max_stall_frac,
+            "value": report["stall_frac"],
+            "ok": report["stall_frac"] <= args.max_stall_frac,
+        },
+        "min_hit_rate": {
+            "limit": args.min_hit_rate,
+            "value": report["hit_rate"],
+            "ok": report["hit_rate"] >= args.min_hit_rate,
+        },
+    }
+    report["ok"] = all(g["ok"] for g in gates.values())
+    return _stats.finalize_report("offload_audit", report, gates=gates,
+                                  json_out=args.json_out)
 
 
 if __name__ == "__main__":

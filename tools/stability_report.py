@@ -149,14 +149,9 @@ def main(argv=None) -> int:
             "value": report["anomaly_rate"],
             "ok": report["anomaly_rate"] <= args.max_anomaly_rate,
         }
-    report["gates"] = gates
     report["ok"] = all(g["ok"] for g in gates.values())
-    text = json.dumps(report, indent=2, sort_keys=True)
-    print(text)
-    if args.json_out:
-        with open(args.json_out, "w") as f:
-            f.write(text + "\n")
-    return 0 if report["ok"] else 1
+    return _stats.finalize_report("stability_report", report, gates=gates,
+                                  json_out=args.json_out)
 
 
 if __name__ == "__main__":
