@@ -433,6 +433,26 @@ def _train_kernel_truth():
         return out
 
 
+def _collective_health_block(health, monitor):
+    """``collective_health`` stamp for detail artifacts (same ride-along
+    pattern as the goodput stamp): p50/p99 skew, straggler rank, desync
+    count off one collective-monitor fold.  Single-controller rungs are
+    one rank — skew and straggler are honestly degenerate there; the
+    staged-record counts and the desync verdict are still real."""
+    if health is None or monitor is None:
+        return None
+    skew = health.get("skew") or {}
+    strag = health.get("straggler") or {}
+    return {
+        "n_ranks": health.get("n_ranks", 1),
+        "records": monitor.seq,
+        "p50_skew_ms": skew.get("p50_ms"),
+        "p99_skew_ms": skew.get("p99_ms"),
+        "straggler_rank": strag.get("rank"),
+        "desync_count": monitor.desync_count,
+    }
+
+
 def bench_comm():
     """Collective wire volume: the ZeRO-3 exchange pair (parameter
     all-gather + gradient reduce-scatter) fp32 vs compressed, on one
@@ -448,8 +468,10 @@ def bench_comm():
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.comm import comm as C
     from deepspeed_tpu.comm.compression import qgz, qwz
     from deepspeed_tpu.parallel import mesh as mesh_lib
+    from deepspeed_tpu.telemetry import collective_monitor as cm
 
     n_dev = jax.device_count()
     if n_dev < 2:
@@ -478,9 +500,12 @@ def bench_comm():
                                    steps=steps)
         return per_step
 
+    # the fp32 pair goes through the comm facade so the rung exercises —
+    # and records into — the collective health plane (trace-time only;
+    # the timed jitted loop is unchanged)
     def ag_fp32(x):
-        return jnp.sum(jax.lax.all_gather(x[0], "fsdp", axis=0,
-                                          tiled=True))[None]
+        return jnp.sum(C.all_gather(x[0], group="fsdp", axis=0,
+                                    tiled=True))[None]
 
     def ag_qwz(x):
         return jnp.sum(qwz.quantized_all_gather(
@@ -495,9 +520,14 @@ def bench_comm():
             x[0], 0, ("fsdp",), bits=bits, block_size=block,
             mean=False))[None]
 
-    t = {name: timed(body) for name, body in
-         (("ag_fp32", ag_fp32), ("ag_qwz", ag_qwz),
-          ("rs_fp32", rs_fp32), ("rs_qgz", rs_qgz))}
+    mon = cm.CollectiveMonitor(rank=0)
+    C.configure_collective_monitor(mon)
+    try:
+        t = {name: timed(body) for name, body in
+             (("ag_fp32", ag_fp32), ("ag_qwz", ag_qwz),
+              ("rs_fp32", rs_fp32), ("rs_qgz", rs_qgz))}
+    finally:
+        C.configure_collective_monitor(None)
 
     ag_wire = qwz.wire_bytes(shard, n_dev, bits=bits, block_size=block)
     ag_logical = qwz.logical_bytes(shard, n_dev)
@@ -518,6 +548,8 @@ def bench_comm():
         "fp32_reduce_scatter_ms": round(t["rs_fp32"] * 1e3, 3),
         "qgz_reduce_scatter_ms": round(t["rs_qgz"] * 1e3, 3),
     }
+    rec["collective_health"] = _collective_health_block(
+        cm.fold_windows([mon.window_view()]), mon)
     try:
         fractions = _zero3_overlap_fractions()
         rec["overlap_fraction"] = fractions["layered"]
@@ -1012,9 +1044,10 @@ def bench_multichip():
     batch = (ids, ids)
     tmp = tempfile.mkdtemp(prefix="bench_mc_")
 
-    def measure(nvme_path=None):
+    def measure(nvme_path=None, telemetry_path=None):
         engine, _, _, _ = deepspeed_tpu.initialize(
-            model=GPT(cfg), config=_offload_train_config(micro, nvme_path),
+            model=GPT(cfg), config=_offload_train_config(
+                micro, nvme_path, telemetry_path=telemetry_path),
             seed=7)
         engine.tput_timer.start_step = 10 ** 12
         for _ in range(2):
@@ -1027,9 +1060,16 @@ def bench_multichip():
 
     try:
         _, t_hbm = measure()
-        e_off, t_off = measure(os.path.join(tmp, "nvme"))
+        e_off, t_off = measure(os.path.join(tmp, "nvme"),
+                               telemetry_path=os.path.join(tmp, "tele.jsonl"))
         sps = micro * n_dev / t_off
         stats = e_off.param_swapper.stats() if e_off.param_swapper else {}
+        health_block = None
+        if (e_off.telemetry is not None
+                and e_off.telemetry.collective_monitor is not None):
+            health_block = _collective_health_block(
+                e_off.telemetry.collective_fold(),
+                e_off.telemetry.collective_monitor)
         rec = {
             "metric": f"multichip offloaded train samples/sec (tiny GPT, "
                       f"seq={seq}, micro={micro}, "
@@ -1041,6 +1081,7 @@ def bench_multichip():
             "in_hbm_step_ms": round(t_hbm * 1e3, 2),
             "offload_step_ms": round(t_off * 1e3, 2),
             "bytes_staged_out": int(stats.get("bytes_written", 0)),
+            "collective_health": health_block,
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -164,6 +164,7 @@ def configure_comms_logger(comms_logger):
 
 
 _METRICS_REGISTRY = None
+_COLLECTIVE_MONITOR = None
 
 
 def configure_metrics_registry(registry):
@@ -177,14 +178,28 @@ def configure_metrics_registry(registry):
     _METRICS_REGISTRY = registry
 
 
+def configure_collective_monitor(monitor):
+    """Attach the per-rank CollectiveMonitor: every collective through the
+    facade then gets a monotonic seq_no + structure fingerprint in the
+    monitor's bounded ring, with enter/exit stamps.  Same trace-time
+    semantics as the other hooks — records mark when collectives were
+    *staged*; eager-boundary callers get true execution brackets."""
+    global _COLLECTIVE_MONITOR
+    _COLLECTIVE_MONITOR = monitor
+
+
 @contextmanager
 def _log_op(name: str, tensor, group=None):
     """Per-collective instrumentation: appends (op, bytes) to the
-    CommsLogger and opens a ``comm.<op>`` span tagged {op, axis, bytes}
-    on the global tracer.  Both fire at *trace* time — the op itself
-    fuses into the XLA program, so the span marks when the collective was
-    staged (and, via jax.named_scope, names it in device profiles); run
-    time shows up in the profiler capture, not here."""
+    CommsLogger, records a seq/fingerprint entry in the collective
+    monitor's ring, and opens a ``comm.<op>`` span tagged
+    {op, axis, bytes, seq} on the global tracer — the span's ``seq``
+    joins trace timelines to collective records by (rank, seq).  All of
+    it fires at *trace* time — the op itself fuses into the XLA program,
+    so the span marks when the collective was staged (and, via
+    jax.named_scope, names it in device profiles); run time shows up in
+    the profiler capture, not here.  Zero-sync: reads only aval metadata
+    (size/dtype/shape), never a device value."""
     fault_point("comm.collective", op=name)
     try:
         nbytes = tensor.size * tensor.dtype.itemsize
@@ -196,13 +211,32 @@ def _log_op(name: str, tensor, group=None):
         _METRICS_REGISTRY.counter("comm_bytes_total",
                                   {"op": name}).inc(nbytes)
         _METRICS_REGISTRY.counter("comm_ops_total", {"op": name}).inc()
-    tracer = get_global_tracer()
-    if tracer is None:
-        yield
-        return
     axis = group if isinstance(group, (str, type(None))) else "+".join(group)
-    with tracer.span(f"comm.{name}", op=name, axis=axis, bytes=nbytes):
-        yield
+    mon = _COLLECTIVE_MONITOR
+    rec = None
+    if mon is not None:
+        try:
+            shape = tuple(tensor.shape)
+        except Exception:
+            shape = ()
+        try:
+            rec = mon.begin(name, axis, str(getattr(tensor, "dtype", "?")),
+                            shape, nbytes)
+        except Exception:
+            rec = None
+    try:
+        tracer = get_global_tracer()
+        if tracer is None:
+            yield
+            return
+        span_args = {"op": name, "axis": axis, "bytes": nbytes}
+        if rec is not None:
+            span_args["seq"] = rec["seq"]
+        with tracer.span(f"comm.{name}", **span_args):
+            yield
+    finally:
+        if rec is not None:
+            mon.end(rec)
 
 
 @contextmanager
@@ -215,15 +249,29 @@ def compressed_op_span(name: str, logical_bytes: int, wire_bytes: int,
     this context fires once per compile, so the engine accounts per-step
     bytes itself from the same accounting helpers."""
     fault_point("comm.collective", op=name)
-    tracer = get_global_tracer()
-    if tracer is None:
-        yield
-        return
     axis = group if isinstance(group, (str, type(None))) else "+".join(group)
-    with tracer.span(f"comm.{name}", op=name, axis=axis,
-                     logical_bytes=int(logical_bytes),
-                     wire_bytes=int(wire_bytes)):
-        yield
+    mon = _COLLECTIVE_MONITOR
+    rec = None
+    if mon is not None:
+        try:
+            rec = mon.begin(name, axis, "", (), int(wire_bytes))
+        except Exception:
+            rec = None
+    try:
+        tracer = get_global_tracer()
+        if tracer is None:
+            yield
+            return
+        span_args = {"op": name, "axis": axis,
+                     "logical_bytes": int(logical_bytes),
+                     "wire_bytes": int(wire_bytes)}
+        if rec is not None:
+            span_args["seq"] = rec["seq"]
+        with tracer.span(f"comm.{name}", **span_args):
+            yield
+    finally:
+        if rec is not None:
+            mon.end(rec)
 
 
 # --------------------------------------------------------------------------- #

@@ -109,6 +109,11 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigModel):
     goodput: bool = True                 # GoodputLedger on the metrics plane
     efficiency_json_path: str = ""       # "" → EFFICIENCY.json next to jsonl
     goodput_peak_tflops_per_chip: float = 0.0   # >0 enables the MFU gauge
+    # collective health plane (README § Collective health): per-rank
+    # seq/fingerprint ring on the comm facade + cross-rank skew/desync
+    # fold at snapshot_every cadence
+    collective_monitor: bool = True      # rides the metrics plane
+    collective_ring: int = 2048          # per-rank record ring capacity
     # hang watchdog + flight recorder
     watchdog_enabled: bool = False
     watchdog_timeout_s: float = 120.0    # stall threshold (monotonic)

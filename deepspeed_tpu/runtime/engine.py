@@ -272,6 +272,11 @@ class DeepSpeedEngine:
                 from deepspeed_tpu.comm import comm as comm_backend
                 comm_backend.configure_metrics_registry(
                     self.telemetry.registry)
+            if self.telemetry.collective_monitor is not None:
+                # per-collective seq/fingerprint ring off the same facade
+                from deepspeed_tpu.comm import comm as comm_backend
+                comm_backend.configure_collective_monitor(
+                    self.telemetry.collective_monitor)
 
         # ---- training-stability sentinel -------------------------------- #
         # None when disabled: the step programs are then built with the
@@ -331,11 +336,17 @@ class DeepSpeedEngine:
                     rank=rank, capacity=tcfg.trace_buffer_size,
                     heartbeat=self.watchdog.pet if self.watchdog else None)
                 set_global_tracer(self.tracer)
+            mon = (self.telemetry.collective_monitor
+                   if self.telemetry is not None else None)
             if self.watchdog is not None:
                 self.flight_recorder = FlightRecorder(
                     tcfg.flight_recorder_dir, rank=rank,
-                    hub=self.telemetry, tracer=self.tracer)
+                    hub=self.telemetry, tracer=self.tracer,
+                    collective_monitor=mon)
                 self.watchdog.on_stall = self.flight_recorder.on_stall
+                if mon is not None:
+                    # stall log names the collective the run is stuck in
+                    self.watchdog.context_fn = mon.wedged_summary
                 if tcfg.watchdog_signal_dump:
                     self.watchdog.install_signal_handlers()
                 self.watchdog.start()
@@ -2749,6 +2760,11 @@ class DeepSpeedEngine:
                 from deepspeed_tpu.comm import comm as comm_backend
                 if comm_backend._METRICS_REGISTRY is self.telemetry.registry:
                     comm_backend.configure_metrics_registry(None)
+            if self.telemetry.collective_monitor is not None:
+                from deepspeed_tpu.comm import comm as comm_backend
+                if (comm_backend._COLLECTIVE_MONITOR
+                        is self.telemetry.collective_monitor):
+                    comm_backend.configure_collective_monitor(None)
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.tracer is not None:

@@ -46,6 +46,9 @@ OFFLOAD_STAGED = "offload_staged"  # per-step staging fold (bytes, ring hits)
 OFFLOAD_WAIT = "offload_wait"      # blocking stall on a staged read/write
 DOWNTIME = "downtime"              # elastic-agent worker_exit -> restart gap
 GOODPUT = "goodput"                # cumulative GoodputLedger snapshot
+COLLECTIVE_WINDOW = "collective_window"    # one rank's collective-ring window
+COLLECTIVE_HEALTH = "collective_health"    # cross-rank skew/straggler fold
+COLLECTIVE_DESYNC = "collective_desync"    # fingerprint divergence detected
 SCHEMA = "schema"                  # JSONL header record (written by the sink)
 
 KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
@@ -53,7 +56,8 @@ KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
          ANOMALY, LR_BACKOFF, AUTO_ROLLBACK, BATCH_QUARANTINED, EF_RESET,
          SERVE_REQUEST, SERVE_STEP, SERVE_PREEMPT, KV_SPILL, KV_RESTAGE,
          PREFIX_HIT, PROGRAM_CACHE, OFFLOAD_STAGED, OFFLOAD_WAIT, DOWNTIME,
-         GOODPUT, SCHEMA)
+         GOODPUT, COLLECTIVE_WINDOW, COLLECTIVE_HEALTH, COLLECTIVE_DESYNC,
+         SCHEMA)
 
 # Every `step` record carries at least these keys once drained.
 STEP_REQUIRED_FIELDS = (

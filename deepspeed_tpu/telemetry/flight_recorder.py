@@ -84,12 +84,15 @@ class FlightRecorder:
     """
 
     def __init__(self, dump_dir: str, rank: int = 0, hub=None, tracer=None,
-                 span_tail: int = 256):
+                 span_tail: int = 256, collective_monitor=None,
+                 collective_tail: int = 64):
         self.dump_dir = dump_dir
         self.rank = int(rank)
         self.hub = hub
         self.tracer = tracer
         self.span_tail = int(span_tail)
+        self.collective_monitor = collective_monitor
+        self.collective_tail = int(collective_tail)
         self._seq = 0
         self._lock = threading.Lock()
 
@@ -117,6 +120,21 @@ class FlightRecorder:
         if hub is None:
             return []
         return [_hang_safe(r) for r in list(getattr(hub, "_pending", []))]
+
+    def _collectives(self) -> Dict[str, Any]:
+        # last-N ring records: a wedge dump names the stuck collective —
+        # an open record (t_exit_us None) at the tail IS the wedge
+        mon = self.collective_monitor
+        if mon is None:
+            return {"records": [], "seq": 0, "desync_count": 0}
+        out = {
+            "records": mon.last_records(self.collective_tail),
+            "seq": mon.seq,
+            "desync_count": mon.desync_count,
+        }
+        if mon.last_desync is not None:
+            out["last_desync"] = _hang_safe(mon.last_desync)
+        return out
 
     def _spans(self) -> Dict[str, Any]:
         tr = self.tracer
@@ -165,6 +183,7 @@ class FlightRecorder:
             emit("open_spans", spans["open"])
             emit("recent_spans", spans["recent"])
             emit("thread_stacks", thread_stacks())
+            emit("collectives", self._collectives())
             emit("end", {"complete": True})
         finally:
             try:

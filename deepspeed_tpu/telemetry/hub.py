@@ -215,6 +215,10 @@ class TelemetryHub:
         self.obs_server = None           # obs_server.ObsServer
         self.snapshot_every = 0          # cross-rank fold cadence (steps)
         self._last_snapshot_step = None
+        # collective health plane (wired up by from_config when enabled)
+        self.collective_monitor = None   # collective_monitor.CollectiveMonitor
+        self._collective_fed_seq = 0     # last seq whose skew reached the sink
+        self._last_collective_health = None
         self._last_step_mono = None
         self._last_flush_mono = time.monotonic()
         # goodput attribution (wired up by from_config when enabled)
@@ -274,8 +278,16 @@ class TelemetryHub:
                     path = os.path.join(os.path.dirname(tcfg.jsonl_path),
                                         "EFFICIENCY.json")
                 hub.efficiency_json_path = path
+            if getattr(tcfg, "collective_monitor", True):
+                from deepspeed_tpu.telemetry.collective_monitor import (
+                    CollectiveMonitor)
+                hub.collective_monitor = CollectiveMonitor(
+                    rank=rank,
+                    capacity=int(getattr(tcfg, "collective_ring", 2048)
+                                 or 2048))
             if getattr(tcfg, "ops_server", False):
-                from deepspeed_tpu.telemetry.obs_server import ObsServer
+                from deepspeed_tpu.telemetry.obs_server import (
+                    ObsServer, collective_desync_health_check)
                 hub.obs_server = ObsServer(
                     hub.registry,
                     host=getattr(tcfg, "ops_host", "127.0.0.1"),
@@ -284,6 +296,12 @@ class TelemetryHub:
                 hub.obs_server.add_health_check("telemetry", hub.health_check)
                 if hub.ledger is not None:
                     hub.obs_server.goodput_fn = hub.ledger.snapshot
+                if hub.collective_monitor is not None:
+                    hub.obs_server.collectives_fn = hub.collective_status
+                    hub.obs_server.add_health_check(
+                        "collective_desync",
+                        collective_desync_health_check(
+                            hub.collective_monitor))
                 hub.obs_server.start()
         return hub
 
@@ -446,6 +464,105 @@ class TelemetryHub:
             metrics_mod.cross_rank_snapshot(self.registry)
         except Exception as e:
             logger.warning(f"cross-rank metrics snapshot failed: {e}")
+        if self.collective_monitor is not None:
+            try:
+                self.collective_fold(step=step)
+            except Exception as e:
+                logger.warning(f"collective health fold failed: {e}")
+
+    def _gather_collective_views(self, view):
+        """Per-rank window views for the fold: multihost gathers packed
+        float64 rows (µs-since-epoch stays exact below 2**53) through
+        ``process_allgather`` — the same piggyback ride the metrics fold
+        takes — and restores record fields from the local fingerprint
+        dictionary (fingerprints this rank never staged stay opaque but
+        still compare, which is all desync detection needs)."""
+        import jax
+        if jax.process_count() <= 1:
+            return [view]
+        import numpy as np
+        from jax.experimental import multihost_utils
+        width = self.collective_monitor.capacity
+        recs = view.get("records", [])[-width:]
+        rows = np.full((width, 4), -1.0, dtype=np.float64)
+        meta = {}
+        for i, r in enumerate(recs):
+            rows[i] = (r["seq"], r["fp"], r["t_enter_us"],
+                       0.0 if r["t_exit_us"] is None else 1.0)
+            meta[int(r["fp"])] = {"op": r["op"], "axis": r["axis"],
+                                  "dtype": r["dtype"],
+                                  "shape": list(r["shape"])}
+        gathered = np.asarray(multihost_utils.process_allgather(rows))
+        views = []
+        for p in range(gathered.shape[0]):
+            records = []
+            for row in gathered[p]:
+                if row[0] < 0:
+                    continue
+                fp = int(row[1])
+                m = meta.get(fp, {"op": "?", "axis": "", "dtype": "?",
+                                  "shape": []})
+                records.append(dict(m, seq=int(row[0]), fp=fp, bytes=0,
+                                    t_enter_us=int(row[2]),
+                                    t_exit_us=0 if row[3] > 0.5 else None))
+            views.append({"rank": p, "records": records})
+        return views
+
+    def collective_fold(self, step: Optional[int] = None,
+                        per_rank_views=None):
+        """Fold the per-rank collective windows into one health verdict
+        and publish it everywhere: a ``collective_window`` record (this
+        rank's ring — the offline fold's input), a ``collective_health``
+        record (whose sink handler is the SINGLE feed path for the
+        ``dstpu_collective_*`` series), a one-shot ``collective_desync``
+        event on first divergence, and the goodput ledger's straggler
+        share.  ``per_rank_views`` overrides the gather (tests, virtual
+        ranks)."""
+        mon = self.collective_monitor
+        if mon is None:
+            return None
+        from deepspeed_tpu.telemetry import collective_monitor as cm
+        view = mon.window_view()
+        views = per_rank_views
+        if views is None:
+            views = self._gather_collective_views(view)
+        health = cm.fold_windows(views, new_after=self._collective_fed_seq)
+        last = (health.get("skew") or {}).get("last_seq", 0)
+        if last > self._collective_fed_seq:
+            self._collective_fed_seq = last
+        self.emit(events.COLLECTIVE_WINDOW, view, step=step)
+        self.emit(events.COLLECTIVE_HEALTH, health, step=step)
+        desync = health.get("desync") or {}
+        if desync.get("detected") and mon.desync_count == 0:
+            mon.note_desync(desync)
+            logger.error(
+                "collective desync detected at seq=%s between ranks %s: %s"
+                % (desync.get("first_seq"), desync.get("ranks"),
+                   desync.get("fingerprints")))
+            self.emit(events.COLLECTIVE_DESYNC, dict(desync), step=step)
+        if self.ledger is not None:
+            skew_s = sum(float(s.get("skew_ms", 0.0))
+                         for s in health.get("skew_samples") or []) / 1e3
+            self.ledger.note_straggler_share(skew_s)
+        self._last_collective_health = health
+        return health
+
+    def collective_status(self) -> Optional[Dict[str, Any]]:
+        """``/collectives`` endpoint body: the last fold verdict plus this
+        rank's newest ring records."""
+        mon = self.collective_monitor
+        if mon is None:
+            return None
+        out = {
+            "rank": mon.rank,
+            "seq": mon.seq,
+            "desync_count": mon.desync_count,
+            "health": self._last_collective_health,
+            "records": mon.last_records(32),
+        }
+        if mon.last_desync is not None:
+            out["last_desync"] = mon.last_desync
+        return out
 
     def health_check(self) -> Dict[str, Any]:
         """`/healthz` contribution: last-step / last-flush ages.  Always
@@ -462,6 +579,14 @@ class TelemetryHub:
     def close(self):
         if self.closed:
             return
+        if self.collective_monitor is not None \
+                and self.collective_monitor.seq:
+            # final fold: short runs that never hit the snapshot cadence
+            # still leave their window + health verdict in the JSONL
+            try:
+                self.collective_fold()
+            except Exception as e:
+                logger.warning(f"final collective fold failed: {e}")
         if self.ledger is not None and not self._goodput_final:
             # final cumulative snapshot: the same dict becomes the last
             # `goodput` record in the JSONL AND the EFFICIENCY.json body,

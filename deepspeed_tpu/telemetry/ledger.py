@@ -130,6 +130,10 @@ class GoodputLedger:
         self.rollbacks = 0
         self.quarantine_skips = 0
         self.replay_until = -1          # steps <= this are recompute
+        # straggler share: the part of exposed_comm attributable to
+        # cross-rank arrival skew (a sub-accounting, NOT a category —
+        # conservation is untouched; fed by the collective-health fold)
+        self.exposed_comm_straggler_s = 0.0
         #: per-SLO-class TTFT bounds (ms); engines may override per config
         self.slo_ttft_bounds_ms = dict(DEFAULT_SLO_TTFT_BOUNDS_MS)
         self._serve = {}                # slo -> token accounting
@@ -153,6 +157,10 @@ class GoodputLedger:
                            help="ledger wall clock (this attempt)")
             registry.gauge("goodput_idle_other_seconds", fn=self._idle,
                            help="wall seconds no instrumented seam claimed")
+            registry.gauge("goodput_exposed_comm_straggler_frac",
+                           fn=self._straggler_frac,
+                           help="share of exposed_comm attributable to "
+                                "cross-rank arrival skew")
 
     # ---- hot path ------------------------------------------------------ #
 
@@ -234,6 +242,15 @@ class GoodputLedger:
         """Watchdog-measured stall time (explicit feed)."""
         self._note("hang", seconds)
 
+    def note_straggler_share(self, seconds):
+        """The collective-health fold measured ``seconds`` of cross-rank
+        arrival skew: book it as the straggler share of ``exposed_comm``.
+        Sub-accounting only — it does not move the mark or any category,
+        it explains how much of the already-attributed exposed_comm a
+        straggling rank caused."""
+        if seconds > 0.0:
+            self.exposed_comm_straggler_s += float(seconds)
+
     def note_quarantine_skip(self, seconds=0.0):
         """A quarantined batch was skipped; ``seconds`` when measured
         out-of-step (in-step share is fed via ``quarantine_frac``)."""
@@ -307,6 +324,12 @@ class GoodputLedger:
     def _mfu_or_zero(self):
         return self._mfu() or 0.0
 
+    def _straggler_frac(self):
+        comm = self._cats["exposed_comm"]
+        if comm <= 0.0:
+            return 0.0
+        return min(self.exposed_comm_straggler_s / comm, 1.0)
+
     def snapshot(self, now=None):
         """Cumulative attribution snapshot (conserves by construction)."""
         if now is None:
@@ -328,6 +351,8 @@ class GoodputLedger:
             "quarantine_skips": self.quarantine_skips,
             "goodput_frac": self._frac(now),
             "mfu": self._mfu(now),
+            "exposed_comm_straggler_s": self.exposed_comm_straggler_s,
+            "exposed_comm_straggler_frac": self._straggler_frac(),
         }
         if self._serve:
             snap["serve"] = serve_summary(self._serve)
@@ -438,9 +463,11 @@ def fold_goodput(records, eps=0.01):
     serve_by_slo = {}
     mfu_vals = []
     mode = None
+    straggler_s = 0.0
     for rid in order:
         snap = last_by_attempt[rid]
         wall += float(snap.get("wall_s", 0.0))
+        straggler_s += float(snap.get("exposed_comm_straggler_s", 0.0))
         for c, v in snap.get("categories", {}).items():
             if c in cats:
                 cats[c] += float(v)
@@ -473,6 +500,10 @@ def fold_goodput(records, eps=0.01):
         "downtime_event_s": downtime_s,
         "goodput_frac": (cats["productive"] / wall) if wall > 0.0 else 0.0,
         "mfu": (sum(mfu_vals) / len(mfu_vals)) if mfu_vals else None,
+        "exposed_comm_straggler_s": straggler_s,
+        "exposed_comm_straggler_frac": (
+            min(straggler_s / cats["exposed_comm"], 1.0)
+            if cats["exposed_comm"] > 0.0 else 0.0),
     }
     if serve_by_slo:
         report["serve"] = serve_summary(serve_by_slo)

@@ -42,6 +42,18 @@ except ImportError:     # standalone (spec-loaded by a no-jax CLI)
     _stats = _ilu.module_from_spec(_spec)
     _spec.loader.exec_module(_stats)
 
+try:
+    from deepspeed_tpu.telemetry import collective_monitor as _cm
+except ImportError:     # standalone (spec-loaded by a no-jax CLI)
+    import importlib.util as _ilu
+    import os as _os
+    _spec = _ilu.spec_from_file_location(
+        "_ds_tpu_telemetry_collective_monitor",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "collective_monitor.py"))
+    _cm = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_cm)
+
 DEFAULT_MS_BUCKETS = _stats.DEFAULT_MS_BUCKETS
 
 _NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -727,6 +739,20 @@ class MetricsSink:
             r.gauge("comm_compression_ratio",
                     {"op": "all"}).set(logical / total)
 
+    def _on_collective_health(self, rec):
+        # the cross-rank fold verdict: incremental skew samples → the
+        # `collective_skew_ms` histogram, straggler scores → gauges — the
+        # SINGLE feed path for dstpu_collective_* series, so the live
+        # registry and offline replay agree by construction
+        _cm.feed_registry(self.registry, rec)
+
+    def _on_collective_desync(self, rec):
+        self.registry.counter("collective_desync_total").inc()
+        desync = rec.get("desync") or rec
+        if isinstance(desync.get("first_seq"), (int, float)):
+            self.registry.gauge("collective_desync_first_seq").set(
+                float(desync["first_seq"]))
+
     def _on_slo_burn(self, rec):
         self.registry.counter(
             "slo_burn_total", {"rule": str(rec.get("rule", "unknown")),
@@ -757,6 +783,8 @@ _SINK_HANDLERS = {
     "lr_backoff": MetricsSink._on_lr_backoff,
     "batch_quarantined": MetricsSink._on_batch_quarantined,
     "comm_summary": MetricsSink._on_comm_summary,
+    "collective_health": MetricsSink._on_collective_health,
+    "collective_desync": MetricsSink._on_collective_desync,
     "slo_burn": MetricsSink._on_slo_burn,
     "downtime": MetricsSink._on_downtime,
 }

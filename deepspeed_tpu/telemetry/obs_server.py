@@ -16,6 +16,9 @@ loopback unless configured otherwise.  Endpoints:
 * ``GET /goodput`` — the live cumulative
   :class:`~deepspeed_tpu.telemetry.ledger.GoodputLedger` snapshot
   (category seconds, goodput fraction, conservation verdict).
+* ``GET /collectives`` — the last cross-rank collective-health fold
+  (skew p50/p99, straggler rank + per-rank scores, desync verdict) plus
+  this rank's newest ring records.
 * ``POST /debug/dump`` (``GET`` accepted for curl ergonomics) — triggers
   a flight-recorder dump and returns its path.
 
@@ -46,6 +49,7 @@ class ObsServer:
         self.flight_recorder = flight_recorder
         self.slo_monitor = slo_monitor
         self.goodput_fn = None     # GoodputLedger.snapshot when wired
+        self.collectives_fn = None  # hub.collective_status when wired
         self.prefix = prefix
         self._checks: Dict[str, Callable[[], Dict[str, Any]]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -90,6 +94,11 @@ class ObsServer:
         if self.goodput_fn is None:
             return None
         return self.goodput_fn()
+
+    def collectives_status(self) -> Optional[Dict[str, Any]]:
+        if self.collectives_fn is None:
+            return None
+        return self.collectives_fn()
 
     def debug_dump(self) -> Dict[str, Any]:
         if self.flight_recorder is None:
@@ -145,6 +154,13 @@ class ObsServer:
                             self._json(404, {"error": "no goodput ledger"})
                         else:
                             self._json(200, g)
+                    elif path == "/collectives":
+                        c = server.collectives_status()
+                        if c is None:
+                            self._json(404,
+                                       {"error": "no collective monitor"})
+                        else:
+                            self._json(200, c)
                     elif path == "/debug/dump":
                         d = server.debug_dump()
                         self._json(200 if d["ok"] else 500, d)
@@ -200,4 +216,13 @@ def watchdog_health_check(watchdog) -> Callable[[], Dict[str, Any]]:
                 "armed": watchdog.armed,
                 "heartbeat_age_s": round(age, 3),
                 "threshold_s": threshold}
+    return _check
+
+
+def collective_desync_health_check(monitor) -> Callable[[], Dict[str, Any]]:
+    """`/healthz` check: 503 once the cross-rank fold has detected a
+    fingerprint desync — and it stays unhealthy (a desynced program is
+    undefined behavior; the only recovery is a restart)."""
+    def _check():
+        return monitor.health_check()
     return _check

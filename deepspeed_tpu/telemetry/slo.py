@@ -112,9 +112,13 @@ class SLORule:
 
 def default_rules(serve_p99_ttft_ms: float = 2000.0,
                   offload_stall_frac: float = 0.15,
-                  step_time_factor: float = 1.5) -> List[SLORule]:
-    """The three stock objectives the issue names, with relaxed default
-    bounds (tighten per deployment via ``telemetry.slo_rules``)."""
+                  step_time_factor: float = 1.5,
+                  collective_p99_skew_ms: float = 1000.0) -> List[SLORule]:
+    """The stock objectives, with relaxed default bounds (tighten per
+    deployment via ``telemetry.slo_rules``).  The collective-skew rule
+    bounds the p99 first-vs-last rank arrival gap the collective health
+    plane folds into ``collective_skew_ms`` — a chronic straggler burns
+    this long before it shows up as a step-time regression."""
     return [
         SLORule("serve_p99_ttft_ms", "serve_ttft_ms", "p99",
                 serve_p99_ttft_ms, cmp="le"),
@@ -123,6 +127,8 @@ def default_rules(serve_p99_ttft_ms: float = 2000.0,
                 den="sum:train_step_time_ms"),
         SLORule("step_time_regression", "train_step_time_ms", "regression",
                 step_time_factor, cmp="le"),
+        SLORule("collective_p99_skew_ms", "collective_skew_ms", "p99",
+                collective_p99_skew_ms, cmp="le"),
     ]
 
 

@@ -63,6 +63,10 @@ class HangWatchdog:
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._prev_handlers = {}
+        #: optional zero-arg callable -> str, logged when a stall fires —
+        #: wired to CollectiveMonitor.wedged_summary so the stall log
+        #: names the collective the run is stuck in
+        self.context_fn: Optional[Callable[[], str]] = None
 
     # -- heartbeat API (hot path: one clock read under a lock) ---------- #
     def arm(self, what: str = ""):
@@ -116,6 +120,11 @@ class HangWatchdog:
         logger.error(
             f"watchdog: no heartbeat for {stalled_s:.1f}s "
             f"(threshold {self.timeout_ns / 1e9:.1f}s) during '{what}'")
+        if self.context_fn is not None:
+            try:
+                logger.error(f"watchdog: {self.context_fn()}")
+            except Exception:
+                pass
         if self.on_stall is not None:
             try:
                 self.on_stall(self, stalled_s, what)

@@ -47,7 +47,7 @@ class TestRuleGrammar:
     def test_default_rules_and_config(self):
         names = {r.name for r in default_rules()}
         assert names == {"serve_p99_ttft_ms", "offload_stall_frac",
-                         "step_time_regression"}
+                         "step_time_regression", "collective_p99_skew_ms"}
         assert {r.name for r in rules_from_config([])} == names
         only = rules_from_config([{"name": "x", "metric": "m",
                                    "op": "value", "bound": 1.0}])

@@ -171,6 +171,43 @@ class TestZeroSyncPass:
         assert any("float()" in m for m in msgs)
         assert any(".item()" in m for m in msgs)
 
+    def test_collective_hot_path_scopes_are_guarded(self):
+        """The collective health plane's staged hot path — the comm
+        facade's _log_op and the monitor's begin/end/fingerprint — is in
+        the checked-scope roster."""
+        scopes = set(zero_sync.CHECKED_SCOPES)
+        assert ("deepspeed_tpu/comm/comm.py", "_log_op") in scopes
+        for scope in ("begin", "end", "fingerprint_of"):
+            assert ("deepspeed_tpu/telemetry/collective_monitor.py",
+                    scope) in scopes
+
+    def test_seeded_sync_in_collective_hot_path_is_flagged(self, tmp_path):
+        """A seeded violation in a monitor-style begin() — coercing the
+        traced tensor's shape/value to build the record — is caught."""
+        sf, _ = _scan(tmp_path, (
+            "class Monitor:\n"
+            "    def begin(self, op, tensor):\n"
+            "        shape = tuple(int(d) for d in tensor.shape)\n"
+            "        nbytes = float(tensor.nbytes)\n"
+            "        return {'op': op, 'shape': shape, 'bytes': nbytes}\n"))
+        msgs = [m for _, m in zero_sync.scope_violations(sf, "begin")]
+        assert len(msgs) == 2
+        assert any("int()" in m for m in msgs)
+        assert any("float()" in m for m in msgs)
+
+    def test_live_collective_hot_path_is_clean(self):
+        """The real comm._log_op and collective_monitor begin/end/
+        fingerprint_of pass the zero-sync check with no pragmas — records
+        carry raw trace-time metadata; int-ification happens at view
+        time, outside the hot path."""
+        ctx = core.Context()
+        sf = ctx.scan("deepspeed_tpu/comm/comm.py", for_pass="zero-sync")
+        assert list(zero_sync.scope_violations(sf, "_log_op")) == []
+        sf = ctx.scan("deepspeed_tpu/telemetry/collective_monitor.py",
+                      for_pass="zero-sync")
+        for scope in ("begin", "end", "fingerprint_of"):
+            assert list(zero_sync.scope_violations(sf, scope)) == []
+
 
 class TestLockDisciplinePass:
     FIXTURE = (

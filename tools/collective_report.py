@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Collective health report — offline skew/straggler/desync forensics.
+
+Folds the ``collective_window`` records of a telemetry JSONL set (one
+bounded ring window per rank per fold cadence; later windows win per
+``(rank, seq)``) through the same pure fold the live hub runs
+(``telemetry/collective_monitor.py:fold_window_records``): per-collective
+first-vs-last rank arrival skew (p50/p99, per-op), the EW straggler
+score naming the chronically-late rank, and the desync verdict — the
+first seq_no where any two ranks staged structurally different
+collectives, with both fingerprints named.  Same family as
+``tools/goodput_report.py``: forensics over run artifacts, no jax.
+
+Multi-rank runs write one JSONL per rank; pass every file and the fold
+merges them (the rank id is inside each window record, so order does not
+matter).
+
+Usage::
+
+    python tools/collective_report.py JSONL [JSONL ...]
+        [--max-skew-ms X] [--forbid-desync] [--json OUT]
+
+``--max-skew-ms`` fails (exit 1) when the folded p99 skew exceeds the
+bound; ``--forbid-desync`` fails when a fingerprint desync was detected.
+Exit 2 on usage errors (unreadable file, no collective records).
+
+Standard library only.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(name):
+    """Load a telemetry module by file path so the tool keeps its no-jax
+    property; package import is the fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", name + ".py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_" + name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    import importlib
+    return importlib.import_module("deepspeed_tpu.telemetry." + name)
+
+
+_stats = _load("stats")
+_cm = _load("collective_monitor")
+
+load_records = _stats.load_records
+fold_window_records = _cm.fold_window_records
+
+
+def load_fold(paths):
+    """→ (health dict, error or None): every file's records merged into
+    one fold (per-rank JSONL sets land here as one file per rank)."""
+    records = []
+    for path in paths:
+        recs, err = load_records(path)
+        if err:
+            return None, err
+        records.extend(recs)
+    health = fold_window_records(records)
+    if health is None:
+        return None, ("no collective_window records (was the run started "
+                      "with telemetry.collective_monitor enabled and "
+                      "snapshot_every set?)")
+    return health, None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Collective skew/straggler/desync report over "
+                    "per-rank telemetry JSONL")
+    ap.add_argument("paths", nargs="+",
+                    help="telemetry JSONL file(s), one per rank")
+    ap.add_argument("--max-skew-ms", type=float, default=None,
+                    help="fail (exit 1) if folded p99 skew exceeds this")
+    ap.add_argument("--forbid-desync", action="store_true",
+                    help="fail (exit 1) if a fingerprint desync was "
+                         "detected")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    health, err = load_fold(args.paths)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    report = {"paths": list(args.paths), **health}
+    gates = {}
+    if args.max_skew_ms is not None:
+        val = (health.get("skew") or {}).get("p99_ms")
+        gates["max_skew_ms"] = {
+            "limit": args.max_skew_ms,
+            "value": val,
+            "ok": val is None or val <= args.max_skew_ms,
+        }
+    if args.forbid_desync:
+        detected = bool((health.get("desync") or {}).get("detected"))
+        gates["forbid_desync"] = {
+            "limit": False,
+            "value": detected,
+            "ok": not detected,
+        }
+    report["ok"] = all(g["ok"] for g in gates.values())
+    return _stats.finalize_report("collective_report", report, gates=gates,
+                                  json_out=args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,14 @@ CHECKED_SCOPES: Sequence[Tuple[str, Optional[str]]] = (
     # host floats only; _acc feeds the mirror counters.
     ("deepspeed_tpu/telemetry/ledger.py", "on_step"),
     ("deepspeed_tpu/telemetry/ledger.py", "_acc"),
+    # collective health hot path: _log_op fires at trace time per staged
+    # collective; the monitor's ring append + fingerprint hash read only
+    # aval metadata (op/axis/dtype/shape) and must never force a traced
+    # value.
+    ("deepspeed_tpu/comm/comm.py", "_log_op"),
+    ("deepspeed_tpu/telemetry/collective_monitor.py", "begin"),
+    ("deepspeed_tpu/telemetry/collective_monitor.py", "end"),
+    ("deepspeed_tpu/telemetry/collective_monitor.py", "fingerprint_of"),
 )
 
 _NUMPY_MODULES = ("np", "numpy")

@@ -17,7 +17,13 @@ Usage::
 
     python tools/obs_report.py TELEMETRY_JSONL
         [--p99-ttft-ms X] [--max-stall-frac X] [--step-time-factor X]
-        [--rule JSON]... [--no-default-rules] [--json OUT]
+        [--max-skew-ms X] [--rule JSON]... [--no-default-rules]
+        [--json OUT]
+
+The replay understands every sink-handled kind, including the collective
+health plane's ``collective_health``/``collective_desync`` records — so
+the ``collective_p99_skew_ms`` default rule is evaluated over exactly
+the skew histogram the live registry carried.
 
 ``--rule`` takes a JSON object in the ``telemetry.slo_rules`` grammar
 (see README § Observability) and may repeat; explicit rules replace the
@@ -113,6 +119,8 @@ def main(argv=None) -> int:
                     help="offload_stall_frac default-rule bound")
     ap.add_argument("--step-time-factor", type=float, default=1.5,
                     help="step_time_regression default-rule factor")
+    ap.add_argument("--max-skew-ms", type=float, default=1000.0,
+                    help="collective_p99_skew_ms default-rule bound")
     ap.add_argument("--rule", action="append", default=[],
                     help="extra SLO rule as JSON (telemetry.slo_rules "
                          "grammar); repeatable")
@@ -132,7 +140,8 @@ def main(argv=None) -> int:
         rules.extend(_slo.default_rules(
             serve_p99_ttft_ms=args.p99_ttft_ms,
             offload_stall_frac=args.max_stall_frac,
-            step_time_factor=args.step_time_factor))
+            step_time_factor=args.step_time_factor,
+            collective_p99_skew_ms=args.max_skew_ms))
     for spec in args.rule:
         try:
             rules.append(_slo.SLORule.from_dict(json.loads(spec)))

@@ -21,6 +21,12 @@ straggler diagnosis The Big Send-off (arXiv:2504.18658) motivates.
 telemetry JSONL into the merged metadata, so the timeline carries the
 per-module FLOPs attribution next to the spans.
 
+``--collectives`` folds the per-rank ``collective_window`` records of
+one or more telemetry JSONL files into the merged metadata, keyed
+``"rank:seq"`` — the same ``seq`` every ``comm.*`` span carries in its
+args, so a span on the timeline joins to its collective record (enter/
+exit stamps, fingerprint, bytes) by (pid, args.seq).
+
 Pure host-side JSON transform: runs anywhere, imports no accelerator.
 """
 
@@ -146,6 +152,30 @@ def compute_overlap(events):
             "overlap_us": overlap_us, "fraction": overlap_us / comm_us}
 
 
+def load_collective_records(jsonl_paths):
+    """Merge the ``collective_window`` records of telemetry JSONL files
+    into a ``{"rank:seq": record}`` join table (later windows win per
+    key — the windows overlap by design).  Returns None when no window
+    records exist."""
+    table = {}
+    for path in jsonl_paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") != "collective_window":
+                    continue
+                rank = rec.get("rank", 0)
+                for r in rec.get("records", []):
+                    table[f"{rank}:{r.get('seq')}"] = r
+    return table or None
+
+
 def load_flops_breakdown(jsonl_path: str):
     """Last ``flops_breakdown`` record in a telemetry JSONL, or None."""
     found = None
@@ -174,6 +204,10 @@ def main(argv=None) -> int:
                         help="merged Chrome-trace output path")
     parser.add_argument("--flops", default="",
                         help="telemetry JSONL to pull a flops_breakdown from")
+    parser.add_argument("--collectives", action="append", default=[],
+                        help="telemetry JSONL to pull collective_window "
+                             "records from (repeatable, one per rank); "
+                             "embeds a rank:seq join table in metadata")
     args = parser.parse_args(argv)
 
     try:
@@ -189,6 +223,18 @@ def main(argv=None) -> int:
             print(f"trace_merge: --flops: {e}", file=sys.stderr)
             return 1
     merged = merge_traces(docs, flops=flops)
+    if args.collectives:
+        try:
+            table = load_collective_records(args.collectives)
+        except OSError as e:
+            print(f"trace_merge: --collectives: {e}", file=sys.stderr)
+            return 1
+        if table is not None:
+            merged["metadata"]["collectives"] = table
+            print(f"joined {len(table)} collective record(s) by (rank, seq)")
+        else:
+            print("trace_merge: --collectives: no collective_window "
+                  "records found", file=sys.stderr)
     overlap = compute_overlap(merged["traceEvents"])
     if overlap is not None:
         merged["metadata"]["overlap"] = overlap
