@@ -1,0 +1,214 @@
+"""Bounded collective execution — deadlines instead of infinite hangs.
+
+A dead or wedged peer turns every staged collective into an infinite
+host-side wait: the survivors sit inside the XLA dispatch (or inside the
+trace that stages it) until the watchdog SIGABRTs the whole job.  This
+module puts a configurable deadline on that wait.  ``BoundedCollective``
+runs the device-blocking callable on a worker thread; the caller waits
+``deadline_s`` and, on expiry, raises :class:`CollectiveTimeout` instead
+of hanging — carrying the seq + structure fingerprint of the newest
+still-open record in the PR 17 collective monitor, so the exception
+names exactly which op died.
+
+Threads cannot be killed in Python, so a timed-out worker is
+*abandoned*: it stays parked on its (daemon) thread until the wedged
+call returns or the process exits, and the next ``run`` gets a fresh
+worker with a fresh queue.  The abandoned count is visible in
+:meth:`BoundedCollective.stats` — a run that keeps abandoning workers
+is wedging repeatedly and should be escalating up the recovery ladder
+(``comm/recovery.py``), not retrying forever.
+
+Granularity: in-program collectives fuse into XLA programs, so a single
+staged op cannot be individually bounded — the deadline brackets the
+*eager seams* where the host actually blocks (compiled-step dispatch in
+the engine, host-level barriers, trace construction).  That is also
+where a wedge manifests, so it is the right place to cut.
+
+Standard library only — no jax at import time (the callable being
+bounded owns all device interaction).
+"""
+
+import os
+import queue
+import threading
+import time
+
+#: env override for the default deadline (seconds); unset/0 disables
+DEADLINE_ENV = "DS_COLLECTIVE_TIMEOUT_S"
+
+
+class CollectiveTimeout(RuntimeError):
+    """A bounded collective (or the step program containing it) exceeded
+    its deadline.  Carries enough identity to attribute the hang: the
+    label of the bounded call, the deadline that expired, and — when a
+    collective monitor was attached — the seq + fingerprint of the
+    newest still-open collective record on this rank."""
+
+    def __init__(self, message, op=None, deadline_s=None, seq=None,
+                 fingerprint=None, axis=None):
+        super().__init__(message)
+        self.op = op
+        self.deadline_s = deadline_s
+        self.seq = seq
+        self.fingerprint = fingerprint
+        self.axis = axis
+
+    def context(self):
+        """JSON-ready identity of the hang (telemetry / abort payloads)."""
+        return {"op": self.op, "deadline_s": self.deadline_s,
+                "seq": self.seq, "fingerprint": self.fingerprint,
+                "axis": self.axis}
+
+
+def default_deadline_s():
+    """The env-configured default deadline, or ``None`` when unbounded."""
+    raw = os.environ.get(DEADLINE_ENV, "")
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return val if val > 0.0 else None
+
+
+class _Job:
+    __slots__ = ("fn", "args", "kwargs", "done", "result", "error")
+
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class BoundedCollective:
+    """Run device-blocking work with a deadline on a reusable worker.
+
+    ``monitor`` is a ``CollectiveMonitor`` (or None): on timeout the
+    newest open record supplies seq/fingerprint for the exception.
+    ``on_timeout`` is an optional callable fired (with the
+    :class:`CollectiveTimeout` about to be raised) before raising — the
+    recovery manager uses it to release interruptible fault-injection
+    wedges so an abandoned worker can drain instead of leaking.
+    """
+
+    def __init__(self, deadline_s=None, monitor=None, on_timeout=None,
+                 clock=time.monotonic):
+        self.deadline_s = deadline_s
+        self.monitor = monitor
+        self.on_timeout = on_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._worker = None            # guarded-by: _lock
+        self._queue = None             # guarded-by: _lock
+        self._generation = 0           # guarded-by: _lock
+        self.abandoned = 0             # workers left wedged on a timeout
+        self.timeouts = 0
+        self.calls = 0
+
+    # -- worker plumbing ---------------------------------------------------- #
+
+    def _worker_main(self, q):
+        while True:
+            job = q.get()
+            if job is None:
+                return
+            try:
+                job.result = job.fn(*job.args, **job.kwargs)
+            except BaseException as e:      # propagate to the caller
+                job.error = e
+            finally:
+                job.done.set()
+
+    def _ensure_worker(self):
+        # requires-lock: _lock
+        if self._worker is None or not self._worker.is_alive():
+            self._queue = queue.SimpleQueue()
+            self._generation += 1
+            self._worker = threading.Thread(
+                target=self._worker_main, args=(self._queue,),
+                name="ds-tpu-bounded-%d" % self._generation, daemon=True)
+            self._worker.start()
+        return self._queue
+
+    def _abandon_worker(self):
+        # requires-lock: _lock
+        self._worker = None
+        self._queue = None
+        self.abandoned += 1
+
+    # -- timeout context ---------------------------------------------------- #
+
+    def _open_record(self):
+        """seq/fp/op of the newest still-open monitor record, if any."""
+        mon = self.monitor
+        if mon is None:
+            return None
+        try:
+            for rec in reversed(mon.last_records(16)):
+                if rec.get("t_exit_us") is None:
+                    return rec
+        except Exception:
+            return None
+        return None
+
+    # -- API ----------------------------------------------------------------- #
+
+    def run(self, fn, *args, op="collective", deadline_s=None, **kwargs):
+        """Execute ``fn(*args, **kwargs)`` under the deadline.
+
+        Resolution order for the bound: explicit ``deadline_s`` argument,
+        the instance default, the ``DS_COLLECTIVE_TIMEOUT_S`` env.  With
+        no bound configured the call runs inline on the caller thread —
+        zero overhead, natural tracebacks, exactly the pre-PR behavior.
+        """
+        bound = deadline_s
+        if bound is None:
+            bound = self.deadline_s
+        if bound is None:
+            bound = default_deadline_s()
+        if not bound or bound <= 0.0:
+            return fn(*args, **kwargs)
+
+        self.calls += 1
+        job = _Job(fn, args, kwargs)
+        with self._lock:
+            q = self._ensure_worker()
+        q.put(job)
+        if not job.done.wait(bound):
+            with self._lock:
+                self._abandon_worker()
+            self.timeouts += 1
+            rec = self._open_record()
+            err = CollectiveTimeout(
+                "collective %r exceeded its %.3fs deadline%s" % (
+                    op, bound,
+                    (" (open seq=%s op=%s fp=%s)" % (
+                        rec["seq"], rec["op"], rec["fp"]) if rec else "")),
+                op=(rec["op"] if rec else op), deadline_s=float(bound),
+                seq=(rec["seq"] if rec else None),
+                fingerprint=(rec["fp"] if rec else None),
+                axis=(rec["axis"] if rec else None))
+            if self.on_timeout is not None:
+                try:
+                    self.on_timeout(err)
+                except Exception:
+                    pass
+            raise err
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def stats(self):
+        return {"calls": self.calls, "timeouts": self.timeouts,
+                "abandoned": self.abandoned,
+                "deadline_s": self.deadline_s}
+
+    def shutdown(self):
+        """Stop the idle worker (wedged workers are already abandoned)."""
+        with self._lock:
+            q, self._queue = self._queue, None
+            self._worker = None
+        if q is not None:
+            q.put(None)

@@ -21,6 +21,9 @@ import subprocess
 import time
 from typing import Callable, Dict, List, Optional
 
+from deepspeed_tpu.comm.recovery import (RECOVERY_EXIT_CODES,
+                                         RENDEZVOUS_DIR_ENV,
+                                         consume_recovery_marker)
 from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
 from deepspeed_tpu.runtime.fault_tolerance import (PREEMPTION_EXIT_CODES,
                                                    backoff_delay)
@@ -73,7 +76,10 @@ class DSElasticAgent:
         every few hours must not accumulate toward give-up forever.
         Workers exiting with the preemption code (143 / -SIGTERM) restart
         immediately without touching the budget: the scheduler took the
-        machine, the program did nothing wrong.  The knobs are overridable
+        machine, the program did nothing wrong.  Coordinator-confirmed
+        recovery exits (reserved codes 113/114, or SIGKILL with a fresh
+        rendezvous marker — see :meth:`_recovery_exit_cause`) are treated
+        the same way.  The knobs are overridable
         via the ``fault_tolerance`` block of ``ds_config``.  ``sleep_fn``
         and ``rng`` are injectable so tests never wall-clock sleep."""
         self.spec = spec
@@ -94,6 +100,7 @@ class DSElasticAgent:
         self._rng = rng
         self.restart_count = 0
         self.preemption_count = 0
+        self.recovery_count = 0
         self._proc: Optional[subprocess.Popen] = None
         self._world = None
         self._start_t: Optional[float] = None
@@ -138,6 +145,35 @@ class DSElasticAgent:
             self.telemetry.flush()
         except Exception as e:
             logger.warning(f"elastic agent: downtime emission failed: {e}")
+
+    def _recovery_exit_cause(self, rc) -> Optional[str]:
+        """Classify a worker exit as a coordinator-directed recovery exit.
+
+        Two confirmation paths, mirroring the recovery ladder's two ways
+        of retiring a process (``comm/recovery.py``):
+
+        * reserved exit codes (113 restart rung / 114 mesh-shrink
+          exclusion) are self-describing — the marker, when present,
+          only refines the cause string;
+        * ``SIGKILL`` (rc ``-9``) is ambiguous (OOM killer kills the same
+          way), so it counts as recovery **only** when the coordinator
+          left a fresh ``recovery_exit.json`` marker in the rendezvous
+          dir — coordinator-confirmed, per the abort protocol.
+
+        Returns the cause string, or None for an ordinary failure."""
+        if rc not in RECOVERY_EXIT_CODES and rc != -signal.SIGKILL:
+            return None   # don't burn the one-shot marker on other exits
+        rdv_dir = (self.spec.env.get(RENDEZVOUS_DIR_ENV)
+                   or os.environ.get(RENDEZVOUS_DIR_ENV))
+        marker = (consume_recovery_marker(rdv_dir)
+                  if rdv_dir else None)
+        if rc in RECOVERY_EXIT_CODES:
+            cause = (marker or {}).get("cause") or (
+                "mesh_shrink" if rc == RECOVERY_EXIT_CODES[1] else "restart")
+            return cause
+        if rc == -signal.SIGKILL and marker is not None:
+            return (marker.get("cause") or "rank_killed")
+        return None
 
     # ------------------------------------------------------------------ #
     def _elastic_env(self, world: int) -> Dict[str, str]:
@@ -225,6 +261,24 @@ class DSElasticAgent:
                     return 0
                 uptime = (time.monotonic() - self._start_t
                           if self._start_t is not None else 0.0)
+                recovery_cause = self._recovery_exit_cause(rc)
+                if recovery_cause is not None:
+                    # the recovery coordinator retired this group on
+                    # purpose (ladder rung exit or confirmed rank kill):
+                    # like a preemption, the program did nothing wrong —
+                    # restart now, burn no crash budget
+                    self.recovery_count += 1
+                    self._last_backoff_s = 0.0
+                    log_dist(f"elastic agent: recovery exit (rc={rc}, "
+                             f"cause={recovery_cause}, uptime "
+                             f"{uptime:.1f}s) — restarting immediately",
+                             ranks=[0])
+                    t_down = time.monotonic()
+                    self._stop(reason=f"recovery:{recovery_cause}")
+                    self._start(self.world_size_fn())
+                    self._emit_downtime(
+                        t_down, f"recovery:{recovery_cause}", rc)
+                    continue
                 if rc in PREEMPTION_EXIT_CODES:
                     # the scheduler reclaimed the machine, not a bug:
                     # restart now, leave the crash budget untouched

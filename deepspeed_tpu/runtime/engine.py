@@ -370,6 +370,13 @@ class DeepSpeedEngine:
                 if self.flight_recorder is not None:
                     srv.flight_recorder = self.flight_recorder
 
+        # ---- coordinated collective recovery ---------------------------- #
+        # After the observability plane (the ladder contributes /recovery
+        # and a /healthz latch to the same server), before anything that
+        # can dispatch a compiled step (forward routes through the bounded
+        # wrapper when recovery is enabled).
+        self._configure_recovery()
+
         # progressive layer drop
         self.progressive_layer_drop = None
         if self._config.pld_config.enabled:
@@ -2054,85 +2061,98 @@ class DeepSpeedEngine:
         with self._span("fwd", step=self.global_steps,
                         micro_step=self.micro_steps) as fwd_rec:
             if self._in_training_mode:
-                if self._cc_active() and self._layered_active():
-                    # Layered ZeRO-3: blocks stay sharded through the scan;
-                    # per-block gathers prefetch ahead of use and per-block
-                    # reduce-scatters fire inside the scan transpose, so
-                    # the collectives hide under block compute.
-                    use_reuse = (self._cc["hpz"]
-                                 and self._hpz_secondary is not None)
-                    if self._cc["hpz"]:
-                        if not use_reuse:
-                            if self._layered_secondary_prog is None:
-                                self._layered_secondary_prog = (
-                                    self._build_layered_secondary())
-                            self._hpz_secondary = (
-                                self._layered_secondary_prog(
-                                    self.state.params))
-                        attr = ("_layered_step_reuse" if use_reuse
-                                else "_layered_step")
-                        step = getattr(self, attr)
-                        if step is None:
-                            step = self._build_layered_step(
-                                batch, reuse=use_reuse)
-                            setattr(self, attr, step)
-                        loss, grads = step(self.state.params,
-                                           self._hpz_secondary, batch,
-                                           self._next_rng(),
-                                           self.state.scaler.scale)
-                    else:
-                        if self._layered_step is None:
-                            self._layered_step = self._build_layered_step(
-                                batch)
-                        loss, grads = self._layered_step(
+                def _dispatch_train():
+                    # build-if-needed + run, as ONE unit: when recovery is
+                    # enabled this thunk runs on the bounded worker thread,
+                    # and the deadline must cover tracing too (a wedged
+                    # collective wedges at trace time, inside _log_op)
+                    if self._cc_active() and self._layered_active():
+                        # Layered ZeRO-3: blocks stay sharded through the
+                        # scan; per-block gathers prefetch ahead of use and
+                        # per-block reduce-scatters fire inside the scan
+                        # transpose, so the collectives hide under block
+                        # compute.
+                        use_reuse = (self._cc["hpz"]
+                                     and self._hpz_secondary is not None)
+                        if self._cc["hpz"]:
+                            if not use_reuse:
+                                if self._layered_secondary_prog is None:
+                                    self._layered_secondary_prog = (
+                                        self._build_layered_secondary())
+                                self._hpz_secondary = (
+                                    self._layered_secondary_prog(
+                                        self.state.params))
+                            attr = ("_layered_step_reuse" if use_reuse
+                                    else "_layered_step")
+                            step = getattr(self, attr)
+                            if step is None:
+                                step = self._build_layered_step(
+                                    batch, reuse=use_reuse)
+                                setattr(self, attr, step)
+                            loss, grads = step(self.state.params,
+                                               self._hpz_secondary, batch,
+                                               self._next_rng(),
+                                               self.state.scaler.scale)
+                        else:
+                            if self._layered_step is None:
+                                self._layered_step = self._build_layered_step(
+                                    batch)
+                            loss, grads = self._layered_step(
+                                self.state.params, batch, self._next_rng(),
+                                self.state.scaler.scale)
+                        self._grads_are_local = False
+                        self._append_cc_bytes(reuse=use_reuse, layered=True)
+                        return "layered", loss, grads
+                    if self._cc_active():
+                        # ZeRO++ path: explicit (compressed) gather +
+                        # hierarchical reduce-scatter programs instead of
+                        # XLA-inserted exact collectives.  hpZ reuses the
+                        # persisted secondary shard until the optimizer
+                        # changes the params.
+                        use_reuse = (self._cc["hpz"]
+                                     and self._hpz_secondary is not None)
+                        if use_reuse:
+                            if self._cc_step_reuse is None:
+                                self._cc_step_reuse = self._build_cc_step(
+                                    batch, reuse=True)
+                            loss, grads = self._cc_step_reuse(
+                                self._hpz_secondary, batch, self._next_rng(),
+                                self.state.scaler.scale)
+                        else:
+                            if self._cc_step is None:
+                                self._cc_step = self._build_cc_step(batch)
+                            out = self._cc_step(self.state.params, batch,
+                                                self._next_rng(),
+                                                self.state.scaler.scale)
+                            if self._cc["hpz"]:
+                                loss, grads, self._hpz_secondary = out
+                            else:
+                                loss, grads = out
+                        self._grads_are_local = False
+                        self._append_cc_bytes(reuse=use_reuse)
+                        return "bulk", loss, grads
+                    if self._onebit_active():
+                        # post-freeze 1-bit path: gradients stay per-device
+                        # here and travel compressed at the gas boundary
+                        # (step())
+                        if self._grad_step_local is None:
+                            self._grad_step_local = (
+                                self._build_grad_step_local(batch))
+                        loss, grads = self._grad_step_local(
                             self.state.params, batch, self._next_rng(),
                             self.state.scaler.scale)
-                    self._grads_are_local = False
-                    self._append_cc_bytes(reuse=use_reuse, layered=True)
-                    fwd_mode = "layered"
-                elif self._cc_active():
-                    # ZeRO++ path: explicit (compressed) gather + hierarchical
-                    # reduce-scatter programs instead of XLA-inserted exact
-                    # collectives.  hpZ reuses the persisted secondary shard
-                    # until the optimizer changes the params.
-                    use_reuse = (self._cc["hpz"]
-                                 and self._hpz_secondary is not None)
-                    if use_reuse:
-                        if self._cc_step_reuse is None:
-                            self._cc_step_reuse = self._build_cc_step(
-                                batch, reuse=True)
-                        loss, grads = self._cc_step_reuse(
-                            self._hpz_secondary, batch, self._next_rng(),
-                            self.state.scaler.scale)
-                    else:
-                        if self._cc_step is None:
-                            self._cc_step = self._build_cc_step(batch)
-                        out = self._cc_step(self.state.params, batch,
-                                            self._next_rng(),
-                                            self.state.scaler.scale)
-                        if self._cc["hpz"]:
-                            loss, grads, self._hpz_secondary = out
-                        else:
-                            loss, grads = out
-                    self._grads_are_local = False
-                    self._append_cc_bytes(reuse=use_reuse)
-                    fwd_mode = "bulk"
-                elif self._onebit_active():
-                    # post-freeze 1-bit path: gradients stay per-device here
-                    # and travel compressed at the gas boundary (step())
-                    if self._grad_step_local is None:
-                        self._grad_step_local = self._build_grad_step_local(batch)
-                    loss, grads = self._grad_step_local(
-                        self.state.params, batch, self._next_rng(),
-                        self.state.scaler.scale)
-                    self._grads_are_local = True
-                else:
+                        self._grads_are_local = True
+                        return None, loss, grads
                     if self._grad_step is None:
                         self._grad_step = self._build_grad_step()
                     loss, grads = self._grad_step(self.state.params, batch,
                                                   self._next_rng(),
                                                   self.state.scaler.scale)
                     self._grads_are_local = False
+                    return None, loss, grads
+
+                fwd_mode, loss, grads = self._run_bounded(
+                    _dispatch_train, op=f"train_step:{self.global_steps}")
                 self._cached_grads = grads
                 self._cached_loss = loss
             else:
@@ -2579,7 +2599,50 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None, batch=None):
         """One full optimizer step over GAS micro-batches in a single XLA
         program.  ``batch`` leaves must have leading dim [gas, micro, ...],
-        or ``data_iter`` yields GAS micro-batches."""
+        or ``data_iter`` yields GAS micro-batches.
+
+        When collective recovery is enabled this is ALSO the recovery
+        boundary: the step runs under the bounded-collective deadline,
+        and a :class:`~deepspeed_tpu.comm.bounded.CollectiveTimeout` (or
+        a peer's abort signal / a dead rank, seen at the boundary poll)
+        opens an incident and runs the policy ladder — retry re-executes
+        this same batch (micro-batches are drawn up front so the iterator
+        is never half-consumed), shrink rebuilds the smaller mesh and
+        reloads the newest checkpoint before re-executing.  After a
+        shrink the step counter rewound to the checkpoint, so a batch
+        that came from ``data_iter`` is redrawn — a step-keyed iterator
+        (one that derives the batch from ``engine.global_steps``) then
+        replays the correct data for the rewound step."""
+        if getattr(self, "recovery_manager", None) is None:
+            return self._train_batch_inner(data_iter, batch)
+        from deepspeed_tpu.comm.bounded import CollectiveTimeout
+
+        def _draw():
+            micro_batches = [next(data_iter) for _ in
+                             range(self.gradient_accumulation_steps())]
+            return jax.tree.map(lambda *xs: jnp.stack(xs), *micro_batches)
+
+        from_iter = batch is None
+        if from_iter:
+            batch = _draw()
+        while True:
+            self._recovery_boundary()
+            if self._recovery_pending_rung == "shrink" and from_iter:
+                batch = _draw()        # counter rewound: held batch is stale
+            try:
+                loss = self._train_batch_inner(None, batch)
+            except CollectiveTimeout as err:
+                self._handle_collective_timeout(err)
+                continue
+            if self._recovery_pending_rung is not None:
+                self.recovery_manager.note_recovered(
+                    self._recovery_pending_rung,
+                    detail={"step": self.global_steps})
+                self._recovery_pending_rung = None
+                self._recovery_attempt = 0
+            return loss
+
+    def _train_batch_inner(self, data_iter=None, batch=None):
         if (getattr(self, "_onebit_comm", None) is not None
                 or getattr(self, "_cc", None) is not None
                 or self.stability is not None):
@@ -2621,8 +2684,6 @@ class DeepSpeedEngine:
             lambda x: jax.device_put(jnp.asarray(x),
                                      NamedSharding(self.mesh, PartitionSpec(None, mesh_lib.BATCH_AXES))),
             batch)
-        if self._fused_step is None:
-            self._fused_step = self._build_fused_step()
         if self.flops_profiler:
             # one micro-batch's cost x gas = the whole fused step
             self.flops_profiler.start_profile(jax.tree.map(lambda x: x[0], batch),
@@ -2640,7 +2701,15 @@ class DeepSpeedEngine:
             # the only route offloaded training takes)
             carry = (self.state.params, self._opt_state_view(),
                      self.state.scaler, self.state.skipped)
-            carry, loss, stats = self._fused_step(carry, batch, self._next_rng())
+
+            def _dispatch_fused():
+                # build + run as one bounded unit (see _dispatch_train)
+                if self._fused_step is None:
+                    self._fused_step = self._build_fused_step()
+                return self._fused_step(carry, batch, self._next_rng())
+
+            carry, loss, stats = self._run_bounded(
+                _dispatch_fused, op=f"fused_step:{self.global_steps}")
             (self.state.params, self.state.opt_state, self.state.scaler,
              self.state.skipped) = carry
             if self.optimizer_swapper is not None:
@@ -2829,6 +2898,329 @@ class DeepSpeedEngine:
         self.close()
         raise SystemExit(PREEMPTION_EXIT_CODE)
 
+    # ------------------------------------------------------------------ #
+    # Coordinated collective recovery (comm/bounded.py + comm/recovery.py)
+    # ------------------------------------------------------------------ #
+    def _configure_recovery(self):
+        """Build the recovery plane from ``ds_config["elasticity"]``:
+
+        * a :class:`~deepspeed_tpu.comm.bounded.BoundedCollective` that
+          runs the compiled-step dispatch on a worker thread under the
+          configured deadline — a wedged collective surfaces as
+          :class:`~deepspeed_tpu.comm.bounded.CollectiveTimeout` (tagged
+          with the seq/fingerprint of the op it died in) instead of
+          hanging the run;
+        * when a rendezvous dir is configured, a host-side
+          :class:`~deepspeed_tpu.comm.recovery.RecoveryCoordinator`
+          (heartbeats + coordinated abort over files, no device comms);
+        * the :class:`~deepspeed_tpu.comm.recovery.RecoveryManager`
+          ladder state machine, wired into ``/recovery`` and
+          ``/healthz`` on the ops server and into the goodput ledger's
+          ``comm_recovery`` category.
+
+        All attributes default to None/disabled so every other code path
+        is untouched when ``recovery_enabled`` is false."""
+        from deepspeed_tpu.comm.recovery import (FileRendezvous,
+                                                 RecoveryCoordinator,
+                                                 RecoveryManager,
+                                                 RecoveryPolicy,
+                                                 resolve_rank_world)
+        self.recovery_policy = RecoveryPolicy.from_config(self._config)
+        self.recovery_coordinator = None
+        self.recovery_manager = None
+        self._bounded = None
+        self._recovery_attempt = 0
+        self._recovery_pending_rung = None
+        self._last_liveness_poll = 0.0
+        if not self.recovery_policy.enabled:
+            return
+        pol = self.recovery_policy
+        if pol.rendezvous_dir:
+            rank, world = resolve_rank_world(default_world=1)
+            rdv = FileRendezvous(pol.rendezvous_dir, rank=rank,
+                                 world_size=world)
+            self.recovery_coordinator = RecoveryCoordinator(rdv, pol).start()
+        self.recovery_manager = RecoveryManager(
+            pol, coordinator=self.recovery_coordinator,
+            telemetry=self.telemetry,
+            ledger=(self.telemetry.ledger
+                    if self.telemetry is not None else None))
+        from deepspeed_tpu.comm.bounded import BoundedCollective
+
+        def _on_timeout(err):
+            # a planted wedge must drain once the deadline fires, or the
+            # abandoned worker thread would hold the trace forever
+            from deepspeed_tpu.testing.fault_injection import release_wedges
+            release_wedges()
+
+        self._bounded = BoundedCollective(
+            deadline_s=pol.collective_timeout_s,
+            monitor=(self.telemetry.collective_monitor
+                     if self.telemetry is not None else None),
+            on_timeout=_on_timeout)
+        if self.telemetry is not None and self.telemetry.obs_server is not None:
+            srv = self.telemetry.obs_server
+            srv.recovery_fn = self.recovery_manager.status
+            srv.add_health_check("recovery",
+                                 self.recovery_manager.health_check)
+        log_dist(f"collective recovery enabled: deadline="
+                 f"{pol.collective_timeout_s}s, rendezvous="
+                 f"{pol.rendezvous_dir or 'none (single-process ladder)'}",
+                 ranks=[0])
+
+    def _run_bounded(self, thunk, op):
+        """Dispatch a compiled-step thunk under the collective deadline.
+        The thunk includes the trace/build (a wedge at
+        ``comm.collective`` fires at trace time), so the deadline covers
+        compilation and execution alike.  No-op passthrough when
+        recovery is disabled."""
+        bounded = getattr(self, "_bounded", None)
+        if bounded is None:
+            return thunk()
+        return bounded.run(thunk, op=op)
+
+    def _recovery_boundary(self):
+        """Step-boundary recovery checks (the one place the host may
+        change course between compiled steps, same seam as the stability
+        ladder and the preemption flag): feed the coordinator the step
+        counter, join any abort a peer signaled, and detect dead ranks
+        (same-host pid probe — one poll, no heartbeat aging)."""
+        coord = self.recovery_coordinator
+        if coord is None:
+            return
+        coord.note_step(self.global_steps)
+        doc = coord.poll_abort()
+        if doc is not None:
+            self.recovery_manager.begin_incident(
+                doc.get("cause", "peer_abort"), detail=doc.get("detail"),
+                step=self.global_steps)
+            self._run_recovery_ladder()
+            return
+        now = time.monotonic()
+        if now - self._last_liveness_poll < self.recovery_policy.heartbeat_interval_s:
+            return
+        self._last_liveness_poll = now
+        dead = coord.dead_ranks()
+        if dead:
+            detail = {"dead_ranks": dead}
+            self.recovery_manager.begin_incident(
+                "rank_dead", detail=detail, step=self.global_steps)
+            coord.request_abort("rank_dead", detail)
+            self._run_recovery_ladder()
+
+    def _handle_collective_timeout(self, err):
+        """A bounded collective expired on THIS rank: open the incident,
+        signal the coordinated abort (first writer wins — peers joining
+        via their own timeouts converge on one abort doc), and run the
+        ladder."""
+        detail = err.context() if hasattr(err, "context") else {
+            "error": str(err)}
+        logger.error(f"collective deadline expired: {detail}")
+        self.recovery_manager.begin_incident(
+            "collective_timeout", detail=detail, step=self.global_steps,
+            backdate_s=getattr(err, "deadline_s", 0.0) or 0.0)
+        if self.recovery_coordinator is not None:
+            self.recovery_coordinator.request_abort(
+                "collective_timeout", detail)
+        self._run_recovery_ladder()
+
+    def _run_recovery_ladder(self):
+        """One ladder iteration for an open incident.
+
+        With a coordinator: ack + barrier so every survivor leaves the
+        jitted step at this boundary, then decide the rung from the
+        survivor set (leader publishes the plan, followers await it).
+        Without one (single-process): the ladder degenerates to
+        retry-then-restart.
+
+        ``retry`` returns to the caller's loop (with program caches
+        dropped — an abandoned trace may have half-built them);
+        ``shrink`` rebuilds the smaller mesh in-process for kept ranks
+        and exits excluded live ranks with
+        :data:`~deepspeed_tpu.comm.recovery.MESH_SHRINK_EXIT_CODE`;
+        ``restart`` exits with
+        :data:`~deepspeed_tpu.comm.recovery.RECOVERY_RESTART_EXIT_CODE`
+        for the elastic agent to relaunch."""
+        mgr = self.recovery_manager
+        pol = self.recovery_policy
+        coord = self.recovery_coordinator
+        if coord is not None:
+            survivors = coord.abort_barrier()
+            world = coord.world_size
+        else:
+            survivors, world = [0], 1
+        attempt = self._recovery_attempt
+        rung = pol.next_rung(attempt, len(survivors), world)
+        mgr.note_rung(rung, attempt=attempt,
+                      detail={"survivors": survivors, "world_size": world})
+        if rung == "retry":
+            self._recovery_attempt += 1
+            self._recovery_pending_rung = "retry"
+            self._invalidate_loss_programs()
+            self._invalidate_apply_programs()
+            self._cached_grads = None
+            self._cached_loss = None
+            self.state.grad_acc = None
+            if coord is not None:
+                coord.advance_epoch()
+            time.sleep(pol.retry_delay_s(attempt))
+            mgr.book_rung_complete()
+            return
+        if rung == "shrink":
+            plan = None
+            if coord is not None and coord.is_leader(survivors):
+                target = pol.shrink_target(len(survivors))
+                kept = list(range(target))
+                dead = sorted(set(range(world)) - set(survivors))
+                if any(r not in survivors for r in kept):
+                    # a kept slot's rank is dead: the survivors cannot
+                    # keep their rank ids on the smaller mesh — degrade
+                    # the whole group to the restart rung
+                    plan = coord.publish_plan(
+                        {"rung": "restart", "cause": "shrink_infeasible",
+                         "dead_ranks": dead})
+                else:
+                    plan = coord.publish_plan(
+                        {"rung": "shrink", "new_world": target,
+                         "kept_ranks": kept, "dead_ranks": dead,
+                         "load_dir": self._last_ckpt_dir})
+            elif coord is not None:
+                plan = coord.await_plan()
+            if plan is None:
+                mgr.note_failed("no_plan",
+                                detail={"survivors": survivors})
+                raise RuntimeError(
+                    "recovery ladder: no shrink plan materialized within "
+                    "the deadline")
+            if plan.get("rung") == "restart":
+                self._recovery_restart_exit(plan)
+            mgr.note_quarantined(plan.get("dead_ranks", []),
+                                 detail={"epoch": plan.get("epoch")})
+            my_rank = coord.rank if coord is not None else 0
+            if my_rank not in plan.get("kept_ranks", []):
+                self._mesh_shrink_exit(plan)
+            self._execute_mesh_shrink(plan)
+            self._recovery_pending_rung = "shrink"
+            mgr.book_rung_complete()
+            return
+        if rung == "restart":
+            self._recovery_restart_exit(None)
+        mgr.note_failed("ladder_exhausted",
+                        detail={"survivors": survivors, "world": world})
+        raise RuntimeError("collective recovery ladder exhausted "
+                           "(retry/shrink/restart all unavailable)")
+
+    def _recovery_restart_exit(self, plan):
+        """Final rung: drop the coordinator-confirmed marker and exit with
+        the reserved restart code — the elastic agent relaunches without
+        burning restart budget (classified like a preemption)."""
+        from deepspeed_tpu.comm.recovery import (RECOVERY_RESTART_EXIT_CODE,
+                                                 write_recovery_marker)
+        pol = self.recovery_policy
+        if pol.rendezvous_dir:
+            try:
+                write_recovery_marker(
+                    pol.rendezvous_dir, "restart",
+                    epoch=(self.recovery_coordinator.epoch
+                           if self.recovery_coordinator is not None else 0),
+                    extra={"plan": plan, "step": self.global_steps})
+            except OSError as e:
+                logger.warning(f"recovery marker write failed: {e}")
+        if self.telemetry is not None:
+            try:
+                self.telemetry.flush()
+            except Exception:
+                pass
+        self.close()
+        raise SystemExit(RECOVERY_RESTART_EXIT_CODE)
+
+    def _mesh_shrink_exit(self, plan):
+        """A live rank excluded by the shrink plan leaves with the
+        reserved exclusion code (and the marker the elastic agent reads)
+        so the exit books as coordinated recovery, not a crash."""
+        from deepspeed_tpu.comm.recovery import (MESH_SHRINK_EXIT_CODE,
+                                                 write_recovery_marker)
+        pol = self.recovery_policy
+        if pol.rendezvous_dir:
+            try:
+                write_recovery_marker(
+                    pol.rendezvous_dir, "mesh_shrink",
+                    epoch=(self.recovery_coordinator.epoch
+                           if self.recovery_coordinator is not None else 0),
+                    extra={"plan": plan, "step": self.global_steps})
+            except OSError as e:
+                logger.warning(f"recovery marker write failed: {e}")
+        log_dist(f"mesh shrink: rank excluded by plan "
+                 f"(new_world={plan.get('new_world')}) — exiting", ranks=[0])
+        if self.telemetry is not None:
+            try:
+                self.telemetry.flush()
+            except Exception:
+                pass
+        self.close()
+        raise SystemExit(MESH_SHRINK_EXIT_CODE)
+
+    def _execute_mesh_shrink(self, plan):
+        """Rebuild this engine on the smaller mesh and reload the newest
+        verified checkpoint (reshard-on-restore re-slices every ZeRO-3
+        shard for the new topology).
+
+        Order matters: mesh/axes first (sharding policies key off it),
+        then parameters/optimizer/offload (each re-plans its shardings),
+        then every compiled program dropped (they all baked the old mesh
+        in), then the checkpoint load — which restores with the CURRENT
+        shardings and runs ``_after_checkpoint_load`` (EF reset, offload
+        residency resync, sentinel re-init)."""
+        new_world = int(plan["new_world"])
+        devices = jax.devices()[:new_world]
+        spec = mesh_lib.MeshSpec.from_config(self._config,
+                                             device_count=new_world)
+        mesh = spec.build(devices)
+        mesh_lib.set_mesh(mesh, spec)
+        self.mesh = mesh
+        self._config.resolve_batch_size(new_world)
+        zc = self._config.zero_config
+        self.zero_policy = ZeroShardingPolicy(
+            mesh, zc.stage, min_size=self.zero_policy.min_size)
+        self._configure_compressed_collectives(zc)
+        # params re-materialize sharded for the new mesh (placeholders —
+        # the checkpoint load below overwrites the values), and the
+        # optimizer/offload planes re-plan their shardings off them
+        self._init_parameters(self.module, None)
+        self._configure_optimizer()
+        self._configure_offload_engine()
+        unit = NamedSharding(mesh, PartitionSpec())
+        self.state.scaler = jax.device_put(
+            jax.device_get(self.state.scaler), unit)
+        self.state.skipped = jax.device_put(
+            jax.device_get(self.state.skipped), unit)
+        if self.stability is not None:
+            self.state.sentinel = self._init_sentinel_device_state()
+        self.state.grad_acc = None
+        self._cached_grads = None
+        self._cached_loss = None
+        # every compiled program baked the old mesh in
+        self._invalidate_loss_programs()
+        self._invalidate_apply_programs()
+        self._acc_step = None
+        self._compress_step = None
+        self._has_overflow_fn = None
+        if getattr(self, "_layered_secondary_prog", None) is not None:
+            self._layered_secondary_prog = None
+        self.reset_compression_state(reason="mesh_shrink")
+        load_dir = plan.get("load_dir") or self._last_ckpt_dir
+        if load_dir:
+            path, _ = self.load_checkpoint(load_dir)
+            log_dist(f"mesh shrink: world={new_world}, resumed from {path}",
+                     ranks=[0])
+        else:
+            logger.warning("mesh shrink: no checkpoint known — continuing "
+                           "from freshly initialized state")
+        if self.recovery_coordinator is not None:
+            self.recovery_coordinator.advance_epoch(
+                new_world_size=len(plan.get("kept_ranks", [])) or new_world)
+        self.recovery_manager.note_world_size(new_world)
+
     def close(self):
         """Release engine resources: join the async checkpoint finalizer
         (surfacing, not raising, any stored failure), drain the checkpoint
@@ -2853,6 +3245,16 @@ class DeepSpeedEngine:
                 self.preemption_handler.stop()
             except Exception as e:
                 logger.warning(f"preemption handler stop failed: {e}")
+        if getattr(self, "recovery_coordinator", None) is not None:
+            try:
+                self.recovery_coordinator.stop()
+            except Exception as e:
+                logger.warning(f"recovery coordinator stop failed: {e}")
+        if getattr(self, "_bounded", None) is not None:
+            try:
+                self._bounded.shutdown()
+            except Exception as e:
+                logger.warning(f"bounded-collective shutdown failed: {e}")
         try:
             self.telemetry_close()
         except Exception as e:

@@ -23,6 +23,9 @@ did not claim, clamped at zero).  The categories:
                      events; in-process via :meth:`note_downtime`)
 ``hang``             watchdog-detected stall time (per-step wall beyond
                      the watchdog threshold)
+``comm_recovery``    coordinated collective-recovery time: detect →
+                     abort barrier → retry/shrink → resume
+                     (``comm/recovery.py`` books it per incident)
 ``idle_other``       residual: wall - sum(everything above), >= 0
 ==================== ===================================================
 
@@ -75,6 +78,7 @@ CATEGORIES = (
     "quarantine_skip",
     "downtime",
     "hang",
+    "comm_recovery",
     "idle_other",
 )
 
@@ -241,6 +245,13 @@ class GoodputLedger:
     def note_hang(self, seconds):
         """Watchdog-measured stall time (explicit feed)."""
         self._note("hang", seconds)
+
+    def note_comm_recovery(self, seconds):
+        """Coordinated collective-recovery time just spent (deadline
+        expiry → abort barrier → ladder rung → resume).  Booked by the
+        recovery manager per incident; mark-advancing like every
+        out-of-step stall, so conservation holds by construction."""
+        self._note("comm_recovery", seconds)
 
     def note_straggler_share(self, seconds):
         """The collective-health fold measured ``seconds`` of cross-rank

@@ -19,11 +19,19 @@ loopback unless configured otherwise.  Endpoints:
 * ``GET /collectives`` — the last cross-rank collective-health fold
   (skew p50/p99, straggler rank + per-rank scores, desync verdict) plus
   this rank's newest ring records.
+* ``GET /recovery`` — the collective-recovery ladder state
+  (``comm/recovery.py:RecoveryManager.status``): current rung, last
+  abort cause, current world size, quarantined ranks.  ``503`` while an
+  incident is in flight or after a terminal failure.
 * ``POST /debug/dump`` (``GET`` accepted for curl ergonomics) — triggers
   a flight-recorder dump and returns its path.
 
 The scrape path only *reads* metric values (one lock per metric), so a
-scraper can never stall the training or serving hot path.
+scraper can never stall the training or serving hot path.  Every
+request socket carries a read/write timeout (``request_timeout_s``,
+default 10s): a scraper that connects and then stalls — mid-request or
+mid-response — gets its handler thread back instead of pinning it
+forever.
 """
 
 import json
@@ -42,7 +50,7 @@ class ObsServer:
 
     def __init__(self, registry, host: str = "127.0.0.1", port: int = 0,
                  flight_recorder=None, slo_monitor=None,
-                 prefix: str = "dstpu_"):
+                 prefix: str = "dstpu_", request_timeout_s: float = 10.0):
         self.registry = registry
         self.host = host
         self._requested_port = int(port)
@@ -50,6 +58,8 @@ class ObsServer:
         self.slo_monitor = slo_monitor
         self.goodput_fn = None     # GoodputLedger.snapshot when wired
         self.collectives_fn = None  # hub.collective_status when wired
+        self.recovery_fn = None    # RecoveryManager.status when wired
+        self.request_timeout_s = float(request_timeout_s)
         self.prefix = prefix
         self._checks: Dict[str, Callable[[], Dict[str, Any]]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -100,6 +110,11 @@ class ObsServer:
             return None
         return self.collectives_fn()
 
+    def recovery_status(self) -> Optional[Dict[str, Any]]:
+        if self.recovery_fn is None:
+            return None
+        return self.recovery_fn()
+
     def debug_dump(self) -> Dict[str, Any]:
         if self.flight_recorder is None:
             return {"ok": False, "error": "no flight recorder configured"}
@@ -116,8 +131,16 @@ class ObsServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # per-request read/write deadline: BaseRequestHandler.setup()
+            # applies it as the connection's socket timeout, so a stalled
+            # scraper times out instead of pinning this handler thread
+            timeout = server.request_timeout_s
+
             def log_message(self, fmt, *args):   # keep stdout clean
                 ...
+
+            def handle_timeout(self):
+                self.close_connection = True
 
             def _reply(self, code: int, body: bytes, ctype: str):
                 self.send_response(code)
@@ -126,8 +149,10 @@ class ObsServer:
                 self.end_headers()
                 try:
                     self.wfile.write(body)
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    # OSError covers socket.timeout: a reader that stopped
+                    # draining mid-response forfeits the rest of the body
+                    self.close_connection = True
 
             def _json(self, code: int, obj):
                 self._reply(code, (json.dumps(obj, sort_keys=True) + "\n")
@@ -161,6 +186,14 @@ class ObsServer:
                                        {"error": "no collective monitor"})
                         else:
                             self._json(200, c)
+                    elif path == "/recovery":
+                        r = server.recovery_status()
+                        if r is None:
+                            self._json(404, {"error": "no recovery manager"})
+                        else:
+                            ok = r.get("ladder_state") in ("idle",
+                                                           "recovered")
+                            self._json(200 if ok else 503, r)
                     elif path == "/debug/dump":
                         d = server.debug_dump()
                         self._json(200 if d["ok"] else 500, d)

@@ -34,7 +34,17 @@ the 1-based hit count it fires on — so "kill the process the 3rd time a
 save reaches pre-commit" is ``{"site": "ckpt.pre_commit", "action":
 "kill", "on_hit": 3}``.  Plans come from :func:`install_plan` (in
 process) or the ``DS_FAULT_PLAN`` env var (subprocess crash tests: a JSON
-string, or ``@/path/to/plan.json``).
+string, or ``@/path/to/plan.json``).  Plans are schema-validated at
+install: an unknown action OR an unknown site raises ``ValueError``
+immediately — a typoed rule must fail loudly, never silently no-op.
+
+Two actions model the collective failure classes the recovery ladder
+(``comm/recovery.py``) is built against: ``kill`` with a ``"signal"``
+parameter dies by signal (``{"signal": 9}`` → the parent observes
+rc=-9, a rank SIGKILLed mid-collective), and ``wedge`` parks the firing
+thread in an infinite-but-interruptible stall (released by
+:func:`release_wedges`, which a bounded-collective timeout triggers, or
+by an optional ``max_wedge_s`` cap).
 
 With no plan installed, ``fault_point`` is a nearly-free no-op — the
 production hot path pays one global read and a ``None`` check.
@@ -46,6 +56,7 @@ before (and without) jax.
 import json
 import os
 import signal
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -54,8 +65,35 @@ PLAN_ENV = "DS_FAULT_PLAN"
 # numeric actions corrupt a value at a value site instead of crashing;
 # "spike" multiplies by the rule's "factor" (default 1e3)
 NUMERIC_ACTIONS = ("nan", "inf", "spike")
-ACTIONS = ("kill", "raise", "sigterm", "delay", "bitflip",
+ACTIONS = ("kill", "raise", "sigterm", "delay", "wedge", "bitflip",
            "truncate") + NUMERIC_ACTIONS
+
+#: every fault_point / numeric_fault / FaultyCheckpointEngine site the
+#: runtime plants — plan validation rejects anything else (a typoed site
+#: must fail loudly, not silently never fire)
+SITES = (
+    "ckpt.pre_save", "ckpt.mid_save", "ckpt.pre_commit", "ckpt.post_commit",
+    "train.step", "train.loss", "train.grads",
+    "comm.collective",
+    "engine.create", "engine.save", "engine.post_save", "engine.commit",
+    "engine.load",
+)
+
+# `wedge` parks the firing thread until released — the infinite-delay
+# model of a stuck peer, interruptible so a bounded-collective timeout
+# (or test teardown) can let the abandoned thread drain
+_WEDGE_RELEASE = threading.Event()
+
+
+def release_wedges():
+    """Release every thread currently parked in a ``wedge`` action (and
+    any future hits of already-armed wedge rules)."""
+    _WEDGE_RELEASE.set()
+
+
+def arm_wedges():
+    """Re-arm ``wedge`` actions after a :func:`release_wedges`."""
+    _WEDGE_RELEASE.clear()
 
 
 class FaultInjected(OSError):
@@ -73,15 +111,22 @@ class FaultRule:
          "times": 1,                      # ... and the times-1 hits after it
          "match": {"tag": "global_step3"},# optional ctx equality filter
          # action parameters:
-         "exit_code": 9,                  # kill
+         "exit_code": 9,                  # kill (os._exit code)
+         "signal": 9,                     # kill by signal instead (rc=-9)
          "message": "...", "errno": 5,    # raise
          "delay_s": 0.05,                 # delay
+         "max_wedge_s": 30.0,             # wedge hard cap (default: none)
          "path": "...", "offset": 12}     # bitflip / truncate
     """
 
     def __init__(self, spec: Dict[str, Any]):
         self.spec = dict(spec)
+        if "site" not in spec:
+            raise ValueError(f"fault rule missing 'site': {spec!r}")
         self.site = str(spec["site"])
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r} "
+                             f"(expected one of {SITES})")
         self.action = str(spec.get("action", "raise"))
         if self.action not in ACTIONS:
             raise ValueError(f"unknown fault action {self.action!r} "
@@ -150,6 +195,12 @@ class FaultInjector:
     def _execute(self, rule: FaultRule, site: str, ctx: Dict[str, Any]):
         spec = rule.spec
         if rule.action == "kill":
+            if spec.get("signal") is not None:
+                # die by signal: the parent's Popen sees rc = -N, the
+                # exact shape of a SIGKILLed-mid-collective rank
+                os.kill(os.getpid(), int(spec["signal"]))
+                time.sleep(30.0)   # SIGKILL needs no handler; never runs on
+                return             # -9 — reached only for catchable signals
             # os._exit: no atexit, no finally blocks — a real crash, which
             # is exactly what the atomic-save guarantees are tested against
             os._exit(int(spec.get("exit_code", 9)))
@@ -162,6 +213,16 @@ class FaultInjector:
                 str(spec.get("message", f"injected fault at {site}")))
         if rule.action == "delay":
             time.sleep(float(spec.get("delay_s", 0.01)))
+            return
+        if rule.action == "wedge":
+            # infinite-but-interruptible stall: the stuck-peer model.  The
+            # parked thread drains the moment release_wedges() runs (a
+            # bounded-collective timeout fires it) or the cap expires.
+            cap = spec.get("max_wedge_s")
+            deadline = (time.monotonic() + float(cap)) if cap else None
+            while not _WEDGE_RELEASE.wait(0.05):
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
             return
         if rule.action in NUMERIC_ACTIONS:
             # numeric actions only make sense at a value site (numeric_fault)
@@ -255,6 +316,8 @@ def clear_plan():
     global _injector, _env_checked
     _injector = None
     _env_checked = False
+    # a released wedge must not leak into the next test's plan
+    arm_wedges()
 
 
 def get_injector() -> Optional[FaultInjector]:

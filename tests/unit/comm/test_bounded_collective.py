@@ -1,0 +1,137 @@
+"""Bounded-collective deadline tests (comm/bounded.py): deadline
+resolution, timeout context enrichment from the collective monitor,
+worker abandonment, and the wedge-release hook.  Pure host threading —
+no jax, no devices."""
+
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.comm.bounded import (DEADLINE_ENV, BoundedCollective,
+                                        CollectiveTimeout,
+                                        default_deadline_s)
+
+
+class TestDeadlineResolution:
+    def test_no_deadline_runs_inline(self):
+        b = BoundedCollective()
+        caller = threading.current_thread().name
+        seen = {}
+
+        def fn():
+            seen["thread"] = threading.current_thread().name
+            return 42
+
+        assert b.run(fn) == 42
+        # without a bound there is no worker hop at all
+        assert seen["thread"] == caller
+        b.shutdown()
+
+    def test_env_deadline(self, monkeypatch):
+        monkeypatch.setenv(DEADLINE_ENV, "12.5")
+        assert default_deadline_s() == 12.5
+        monkeypatch.delenv(DEADLINE_ENV)
+        assert default_deadline_s() is None
+
+    def test_env_deadline_invalid_ignored(self, monkeypatch):
+        monkeypatch.setenv(DEADLINE_ENV, "not-a-number")
+        assert default_deadline_s() is None
+
+    def test_per_call_overrides_instance(self):
+        b = BoundedCollective(deadline_s=0.05)
+        # generous per-call bound lets a slowish fn through
+        assert b.run(lambda: (time.sleep(0.1), "ok")[1],
+                     deadline_s=5.0) == "ok"
+        b.shutdown()
+
+
+class TestTimeout:
+    def test_result_passthrough(self):
+        b = BoundedCollective(deadline_s=5.0)
+        assert b.run(lambda x, k=None: (x, k), 1, k="v") == (1, "v")
+        b.shutdown()
+
+    def test_exception_passthrough(self):
+        b = BoundedCollective(deadline_s=5.0)
+
+        def boom():
+            raise ValueError("inner")
+
+        with pytest.raises(ValueError, match="inner"):
+            b.run(boom)
+        b.shutdown()
+
+    def test_timeout_raises_with_context(self):
+        b = BoundedCollective(deadline_s=0.1)
+        release = threading.Event()
+        with pytest.raises(CollectiveTimeout) as ei:
+            b.run(release.wait, 30.0, op="all_gather")
+        err = ei.value
+        assert err.op == "all_gather"
+        assert err.deadline_s == 0.1
+        ctx = err.context()
+        assert ctx["op"] == "all_gather"
+        release.set()
+        b.shutdown()
+
+    def test_worker_abandoned_and_replaced(self):
+        b = BoundedCollective(deadline_s=0.1)
+        release = threading.Event()
+        with pytest.raises(CollectiveTimeout):
+            b.run(release.wait, 30.0)
+        assert b.stats()["abandoned"] == 1
+        # a fresh worker serves the next call even while the old one hangs
+        assert b.run(lambda: "alive") == "alive"
+        release.set()
+        b.shutdown()
+
+    def test_on_timeout_hook_fires(self):
+        fired = []
+        b = BoundedCollective(deadline_s=0.1,
+                              on_timeout=lambda err: fired.append(err))
+        release = threading.Event()
+        with pytest.raises(CollectiveTimeout):
+            b.run(release.wait, 30.0)
+        assert len(fired) == 1 and isinstance(fired[0], CollectiveTimeout)
+        release.set()
+        b.shutdown()
+
+    def test_monitor_open_record_enriches_timeout(self):
+        class FakeMonitor:
+            def last_records(self, n):
+                # same record shape as CollectiveMonitor.begin builds
+                return [
+                    {"seq": 7, "fp": 111, "op": "all_reduce",
+                     "axis": "fsdp", "t_exit_us": 123},
+                    {"seq": 8, "fp": 222, "op": "all_gather",
+                     "axis": "fsdp", "t_exit_us": None},   # wedged (open)
+                ]
+
+        b = BoundedCollective(deadline_s=0.1, monitor=FakeMonitor())
+        release = threading.Event()
+        with pytest.raises(CollectiveTimeout) as ei:
+            b.run(release.wait, 30.0)
+        assert ei.value.seq == 8
+        assert ei.value.fingerprint == 222
+        assert ei.value.axis == "fsdp"
+        assert ei.value.op == "all_gather"
+        release.set()
+        b.shutdown()
+
+
+class TestLifecycle:
+    def test_shutdown_idempotent(self):
+        b = BoundedCollective(deadline_s=1.0)
+        assert b.run(lambda: 1) == 1
+        b.shutdown()
+        b.shutdown()
+
+    def test_stats_shape(self):
+        b = BoundedCollective(deadline_s=1.0)
+        b.run(lambda: None)
+        s = b.stats()
+        assert s["calls"] >= 1
+        assert s["timeouts"] == 0
+        assert s["abandoned"] == 0
+        b.shutdown()

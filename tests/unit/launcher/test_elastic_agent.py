@@ -254,3 +254,127 @@ class TestRestartHygiene:
         assert rec["uptime_s"] is not None and rec["uptime_s"] >= 0
         assert rec["backoff_s"] == 0.0
         assert rec["preemption_count"] == 0
+
+class TestRecoveryExitClassification:
+    """Coordinator-confirmed recovery exits (mesh shrink 114, elastic
+    restart 113, SIGKILL with a fresh marker) restart like preemptions:
+    immediately, without burning the failure-restart budget."""
+
+    def _hub(self):
+        class Hub:
+            def __init__(self):
+                self.events = []
+
+            def emit(self, kind, payload, **kw):
+                self.events.append((kind, payload))
+
+            def flush(self):
+                ...
+
+        return Hub()
+
+    def _marker_body(self, tmp_path, rdv, first_rc, cause):
+        """Worker exits ``first_rc`` once (writing the recovery marker),
+        then 0."""
+        import deepspeed_tpu
+        repo = os.path.dirname(os.path.dirname(deepspeed_tpu.__file__))
+        marker = tmp_path / "attempt"
+        return (
+            "import os, sys\n"
+            f"sys.path.insert(0, {repo!r})\n"
+            "from deepspeed_tpu.comm.recovery import write_recovery_marker\n"
+            f"m = {str(marker)!r}\n"
+            "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+            "open(m, 'w').write(str(n + 1))\n"
+            "if n == 0:\n"
+            f"    write_recovery_marker({str(rdv)!r}, {cause!r})\n"
+            f"    sys.exit({first_rc})\n"
+            "sys.exit(0)\n")
+
+    def test_mesh_shrink_exit_restarts_without_budget(self, tmp_path):
+        from deepspeed_tpu.comm.recovery import (MESH_SHRINK_EXIT_CODE,
+                                                 RENDEZVOUS_DIR_ENV)
+        rdv = tmp_path / "rdv"
+        body = self._marker_body(tmp_path, rdv, MESH_SHRINK_EXIT_CODE,
+                                 "mesh_shrink")
+        hub = self._hub()
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, body),
+                       env={RENDEZVOUS_DIR_ENV: str(rdv)}),
+            max_restarts=0, monitor_interval=0.1, sleep_fn=lambda s: None,
+            telemetry=hub)
+        assert agent.run() == 0
+        assert agent.recovery_count == 1
+        assert agent.restart_count == 0       # budget untouched
+        reasons = [p.get("reason") for k, p in hub.events
+                   if k == "downtime"]
+        assert "recovery:mesh_shrink" in reasons
+
+    def test_restart_exit_without_marker_still_classified(self, tmp_path):
+        """rc=113/114 are reserved recovery codes: even if the marker is
+        missing (crashed before writing), classify by code."""
+        from deepspeed_tpu.comm.recovery import RECOVERY_RESTART_EXIT_CODE
+        marker = tmp_path / "attempt"
+        body = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+            "open(m, 'w').write(str(n + 1))\n"
+            f"sys.exit({RECOVERY_RESTART_EXIT_CODE} if n == 0 else 0)\n")
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, body)),
+                               max_restarts=0, monitor_interval=0.1,
+                               sleep_fn=lambda s: None)
+        assert agent.run() == 0
+        assert agent.recovery_count == 1
+        assert agent.restart_count == 0
+
+    def test_sigkill_with_marker_is_recovery(self, tmp_path):
+        """A rank SIGKILLed mid-collective after the coordinator marked
+        the incident restarts like a preemption, not a crash."""
+        from deepspeed_tpu.comm.recovery import (RENDEZVOUS_DIR_ENV,
+                                                 write_recovery_marker)
+        rdv = tmp_path / "rdv"
+        marker = tmp_path / "attempt"
+        body = (
+            "import os, sys, signal\n"
+            f"m = {str(marker)!r}\n"
+            "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+            "open(m, 'w').write(str(n + 1))\n"
+            "if n == 0:\n"
+            "    os.kill(os.getpid(), signal.SIGKILL)\n"
+            "sys.exit(0)\n")
+        write_recovery_marker(str(rdv), "rank_killed")
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, body),
+                       env={RENDEZVOUS_DIR_ENV: str(rdv)}),
+            max_restarts=0, monitor_interval=0.1, sleep_fn=lambda s: None)
+        assert agent.run() == 0
+        assert agent.recovery_count == 1
+        assert agent.restart_count == 0
+
+    def test_sigkill_without_marker_is_ordinary_failure(self, tmp_path):
+        body = ("import os, signal\n"
+                "os.kill(os.getpid(), signal.SIGKILL)\n")
+        agent = DSElasticAgent(WorkerSpec(_script(tmp_path, body)),
+                               max_restarts=0, monitor_interval=0.1,
+                               sleep_fn=lambda s: None)
+        rc = agent.run()
+        assert rc != 0
+        assert agent.recovery_count == 0
+
+    def test_marker_not_burned_on_unrelated_exit(self, tmp_path):
+        """An ordinary rc=1 crash must not consume a pending recovery
+        marker meant for a later recovery exit."""
+        from deepspeed_tpu.comm.recovery import (RENDEZVOUS_DIR_ENV,
+                                                 consume_recovery_marker,
+                                                 write_recovery_marker)
+        rdv = tmp_path / "rdv"
+        write_recovery_marker(str(rdv), "mesh_shrink")
+        agent = DSElasticAgent(
+            WorkerSpec(_script(tmp_path, "import sys; sys.exit(1)\n"),
+                       env={RENDEZVOUS_DIR_ENV: str(rdv)}),
+            max_restarts=0, monitor_interval=0.1, sleep_fn=lambda s: None)
+        assert agent.run() == 1
+        assert agent.recovery_count == 0
+        # the marker survives for the recovery exit it belongs to
+        assert consume_recovery_marker(str(rdv))["cause"] == "mesh_shrink"

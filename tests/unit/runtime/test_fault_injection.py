@@ -31,62 +31,116 @@ def _clean_global_plan():
 
 class TestFaultRule:
     def test_fires_on_nth_hit_only(self):
-        inj = FaultInjector([{"site": "s", "action": "raise", "on_hit": 3}])
-        inj.fire("s")
-        inj.fire("s")
+        inj = FaultInjector([{"site": "train.step", "action": "raise", "on_hit": 3}])
+        inj.fire("train.step")
+        inj.fire("train.step")
         with pytest.raises(FaultInjected):
-            inj.fire("s")
-        inj.fire("s")                      # times=1: the window has passed
+            inj.fire("train.step")
+        inj.fire("train.step")                      # times=1: the window has passed
         assert [e["hit"] for e in inj.log] == [3]
 
     def test_times_window(self):
-        inj = FaultInjector([{"site": "s", "action": "raise",
+        inj = FaultInjector([{"site": "train.step", "action": "raise",
                               "on_hit": 2, "times": 2}])
-        inj.fire("s")
+        inj.fire("train.step")
         for _ in range(2):
             with pytest.raises(FaultInjected):
-                inj.fire("s")
-        inj.fire("s")
+                inj.fire("train.step")
+        inj.fire("train.step")
 
     def test_match_filters_on_ctx(self):
-        inj = FaultInjector([{"site": "s", "action": "raise",
+        inj = FaultInjector([{"site": "train.step", "action": "raise",
                               "match": {"tag": "t2"}}])
-        inj.fire("s", tag="t1")            # no match, counter untouched
+        inj.fire("train.step", tag="t1")            # no match, counter untouched
         with pytest.raises(FaultInjected):
-            inj.fire("s", tag="t2")
+            inj.fire("train.step", tag="t2")
 
     def test_site_mismatch_never_counts(self):
-        inj = FaultInjector([{"site": "a", "action": "raise"}])
-        inj.fire("b")
-        inj.fire("b")
+        inj = FaultInjector([{"site": "train.step", "action": "raise"}])
+        inj.fire("train.loss")
+        inj.fire("train.loss")
         assert inj.rules[0].hits == 0
 
     def test_unknown_action_rejected(self):
         with pytest.raises(ValueError):
-            FaultRule({"site": "s", "action": "explode"})
+            FaultRule({"site": "train.step", "action": "explode"})
         assert "kill" in ACTIONS
 
+    def test_unknown_site_rejected(self):
+        """A typoed site must fail loudly at plan install, not silently
+        never fire."""
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultRule({"site": "comm.colective", "action": "raise"})
+        with pytest.raises(ValueError, match="unknown fault site"):
+            install_plan([{"site": "nope", "action": "raise"}])
+
+    def test_missing_site_rejected(self):
+        with pytest.raises(ValueError, match="missing 'site'"):
+            FaultRule({"action": "raise"})
+
+    def test_every_planted_site_is_registered(self):
+        from deepspeed_tpu.testing.fault_injection import SITES
+        for site in ("ckpt.pre_commit", "train.step", "train.loss",
+                     "train.grads", "comm.collective", "engine.save"):
+            assert site in SITES
+
+    def test_wedge_is_interruptible(self):
+        """wedge parks the firing thread until release_wedges() — the
+        stuck-peer model a bounded collective must be able to cut."""
+        import threading
+        from deepspeed_tpu.testing.fault_injection import (arm_wedges,
+                                                           release_wedges)
+        arm_wedges()
+        inj = FaultInjector([{"site": "comm.collective", "action": "wedge"}])
+        done = threading.Event()
+
+        def _target():
+            inj.fire("comm.collective", op="all_reduce")
+            done.set()
+
+        t = threading.Thread(target=_target, daemon=True)
+        t.start()
+        assert not done.wait(0.2)          # parked
+        release_wedges()
+        assert done.wait(2.0)              # drained the moment it released
+        t.join(timeout=2.0)
+
+    def test_wedge_cap_expires(self):
+        from deepspeed_tpu.testing.fault_injection import arm_wedges
+        arm_wedges()
+        inj = FaultInjector([{"site": "comm.collective", "action": "wedge",
+                              "max_wedge_s": 0.1}])
+        t0 = time.monotonic()
+        inj.fire("comm.collective", op="all_gather")
+        assert 0.05 <= time.monotonic() - t0 < 5.0
+
+    def test_kill_by_signal_rule_parses(self):
+        # the -9 path itself is exercised by the subprocess recovery e2e
+        r = FaultRule({"site": "comm.collective", "action": "kill",
+                       "signal": 9})
+        assert int(r.spec["signal"]) == 9
+
     def test_raise_carries_errno_and_is_oserror(self):
-        inj = FaultInjector([{"site": "s", "action": "raise", "errno": 28,
+        inj = FaultInjector([{"site": "train.step", "action": "raise", "errno": 28,
                               "message": "disk full"}])
         with pytest.raises(OSError) as ei:
-            inj.fire("s")
+            inj.fire("train.step")
         assert ei.value.errno == 28
         assert "disk full" in str(ei.value)
 
     def test_delay_action_sleeps(self):
-        inj = FaultInjector([{"site": "s", "action": "delay",
+        inj = FaultInjector([{"site": "train.step", "action": "delay",
                               "delay_s": 0.05}])
         t0 = time.monotonic()
-        inj.fire("s")
+        inj.fire("train.step")
         assert time.monotonic() - t0 >= 0.04
 
     def test_sigterm_action_reaches_handler(self):
         from deepspeed_tpu.runtime.fault_tolerance import PreemptionHandler
         h = PreemptionHandler().install()
         try:
-            inj = FaultInjector([{"site": "s", "action": "sigterm"}])
-            inj.fire("s")
+            inj = FaultInjector([{"site": "train.step", "action": "sigterm"}])
+            inj.fire("train.step")
             for _ in range(100):           # delivery is async-ish
                 if h.triggered:
                     break
@@ -124,18 +178,18 @@ class TestGlobalPlan:
         fault_point("anything", step=1)    # must not raise
 
     def test_install_and_clear(self):
-        install_plan([{"site": "s", "action": "raise"}])
+        install_plan([{"site": "train.step", "action": "raise"}])
         with pytest.raises(FaultInjected):
-            fault_point("s")
+            fault_point("train.step")
         clear_plan()
-        fault_point("s")
+        fault_point("train.step")
 
     def test_env_plan_json(self, monkeypatch):
         monkeypatch.setenv(PLAN_ENV, json.dumps(
-            [{"site": "env.site", "action": "raise"}]))
+            [{"site": "train.step", "action": "raise"}]))
         clear_plan()                       # force a fresh env read
         with pytest.raises(FaultInjected):
-            fault_point("env.site")
+            fault_point("train.step")
 
     def test_comm_collective_site_fires(self):
         """comm._log_op carries the comm.collective site (ctx: op) so a
@@ -151,12 +205,12 @@ class TestGlobalPlan:
 
     def test_env_plan_at_file(self, monkeypatch, tmp_path):
         plan = tmp_path / "plan.json"
-        plan.write_text(json.dumps([{"site": "f.site", "action": "raise"}]))
+        plan.write_text(json.dumps([{"site": "ckpt.pre_save", "action": "raise"}]))
         monkeypatch.setenv(PLAN_ENV, f"@{plan}")
         clear_plan()
         assert get_injector() is not None
         with pytest.raises(FaultInjected):
-            fault_point("f.site")
+            fault_point("ckpt.pre_save")
 
 
 class TestNumericFaults:
@@ -181,10 +235,10 @@ class TestNumericFaults:
         assert int(out["step"]) == 7                      # ints untouched
 
     def test_inf_and_spike(self):
-        install_plan([{"site": "a", "action": "inf"},
-                      {"site": "b", "action": "spike", "factor": 100.0}])
-        assert np.isinf(np.asarray(numeric_fault("a", np.float32(3.0))))
-        spiked = numeric_fault("b", np.full((4,), 2.0, np.float32))
+        install_plan([{"site": "train.loss", "action": "inf"},
+                      {"site": "train.grads", "action": "spike", "factor": 100.0}])
+        assert np.isinf(np.asarray(numeric_fault("train.loss", np.float32(3.0))))
+        spiked = numeric_fault("train.grads", np.full((4,), 2.0, np.float32))
         np.testing.assert_allclose(np.asarray(spiked), 200.0)
 
     def test_on_hit_counter_is_deterministic(self):

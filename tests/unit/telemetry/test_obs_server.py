@@ -151,3 +151,73 @@ class TestHealthz:
         snap = reg.snapshot()
         assert snap["gauges"]["watchdog_heartbeat_age_s"][
             "value"] == pytest.approx(3.0)
+
+class TestRecoveryEndpoint:
+    def test_404_without_recovery_manager(self, server):
+        _, srv = server
+        code, body, _ = _get(f"{srv.url}/recovery")
+        assert code == 404
+        assert "no recovery manager" in json.loads(body)["error"]
+
+    def test_200_when_idle_or_recovered(self, server):
+        _, srv = server
+        state = {"ladder_state": "idle", "incidents": 0}
+        srv.recovery_fn = lambda: dict(state)
+        code, body, _ = _get(f"{srv.url}/recovery")
+        assert code == 200
+        assert json.loads(body)["ladder_state"] == "idle"
+        state["ladder_state"] = "recovered"
+        code, _, _ = _get(f"{srv.url}/recovery")
+        assert code == 200
+
+    def test_503_mid_incident(self, server):
+        _, srv = server
+        srv.recovery_fn = lambda: {"ladder_state": "aborting",
+                                   "incidents": 1, "cause": "rank_dead"}
+        code, body, _ = _get(f"{srv.url}/recovery")
+        assert code == 503
+        assert json.loads(body)["cause"] == "rank_dead"
+
+    def test_live_recovery_manager_wiring(self, server):
+        from deepspeed_tpu.comm.recovery import (RecoveryManager,
+                                                 RecoveryPolicy)
+        _, srv = server
+        mgr = RecoveryManager(RecoveryPolicy(enabled=True))
+        srv.recovery_fn = mgr.status
+        assert _get(f"{srv.url}/recovery")[0] == 200
+        mgr.begin_incident("collective_timeout")
+        assert _get(f"{srv.url}/recovery")[0] == 503
+        mgr.note_rung("retry", attempt=0)
+        mgr.note_recovered("retry")
+        assert _get(f"{srv.url}/recovery")[0] == 200
+
+
+class TestRequestTimeouts:
+    def test_timeout_configured_on_handler(self):
+        reg = MetricsRegistry()
+        srv = ObsServer(reg, port=0, request_timeout_s=3.5).start()
+        try:
+            assert srv.request_timeout_s == 3.5
+            # a normal request still completes under the per-request bound
+            code, _, _ = _get(f"{srv.url}/metrics")
+            assert code == 200
+        finally:
+            srv.stop()
+
+    def test_slow_client_does_not_wedge_server(self):
+        """A client that connects and never sends a request line must be
+        timed out by the per-request socket deadline, leaving the server
+        responsive for well-behaved clients."""
+        import socket as _socket
+        import time as _time
+        reg = MetricsRegistry()
+        srv = ObsServer(reg, port=0, request_timeout_s=0.2).start()
+        try:
+            host, port = srv.url.replace("http://", "").split(":")
+            wedge = _socket.create_connection((host, int(port)))
+            _time.sleep(0.5)   # past the request deadline, sent nothing
+            code, _, _ = _get(f"{srv.url}/metrics")
+            assert code == 200
+            wedge.close()
+        finally:
+            srv.stop()

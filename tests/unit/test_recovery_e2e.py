@@ -1,0 +1,319 @@
+"""Coordinated collective recovery, end to end over real OS processes.
+
+Two incidents, each a separate process group coordinating ONLY through
+the file rendezvous (no device comms between processes — each worker is
+a self-contained SPMD run over its own virtual-device mesh):
+
+* **SIGKILL → elastic mesh shrink**: world=4, one rank SIGKILLed
+  mid-run after the leader has a verified checkpoint.  Survivors detect
+  the death by pid probe, converge on the coordinated abort at a step
+  boundary, the leader publishes the shrink plan, kept ranks rebuild on
+  the smaller mesh and resume from the checkpoint, the excluded live
+  rank exits with the reserved mesh-shrink code, and the final loss
+  matches a clean small-world run resumed from the same checkpoint.
+  Bounded wall time; every process reaped.
+
+* **Wedge → retry (no shrink)**: world=2, both ranks' first staged
+  collective wedges under the deadline.  The bounded collectives raise
+  instead of hanging, the ranks converge on one coordinated abort, both
+  retry in place — no mesh shrink — and the wedged wait books into the
+  conserved ``comm_recovery`` ledger category with conservation within
+  1%.
+
+``tools/recovery_report.py`` gates run over the artifacts both
+scenarios emit."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HIDDEN = 16
+BATCH = 8
+
+#: collective deadline for the wedge scenario — must comfortably exceed
+#: a genuine post-retry recompile on a contended CPU (an innocent
+#: dispatch slower than the deadline would open a spurious incident),
+#: while the wedge itself is infinite so any bound catches it
+WEDGE_DEADLINE_S = 10.0
+
+WORKER = textwrap.dedent("""\
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel
+
+    cfgv = json.loads(sys.argv[1])
+    model = SimpleModel(hidden_dim={hidden})
+    params = model.init_params(jax.random.key(0))
+    mesh = None
+    if cfgv.get("mesh_devices"):
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+        n = int(cfgv["mesh_devices"])
+        spec = mesh_lib.MeshSpec(fsdp=n, device_count=n)
+        mesh = spec.build(jax.devices()[:n])
+        mesh_lib.set_mesh(mesh, spec)
+    config = {{
+        "train_batch_size": {batch},
+        "steps_per_print": 0,
+        "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+        "zero_optimization": {{"stage": 3, "zero_quantized_gradients": True,
+                               "param_shard_min_size": 1}},
+        "checkpoint": {{"engine": "local"}},
+        "elasticity": {{"recovery_enabled": True,
+                        "collective_timeout_s": cfgv.get("deadline", 300.0),
+                        "heartbeat_interval_s": 0.2,
+                        "heartbeat_timeout_s": 3.0,
+                        "max_step_retries": 2,
+                        "retry_backoff_s": 0.1,
+                        "recovery_deadline_s": 480.0}},
+        "telemetry": {{"enabled": True, "jsonl_path": cfgv["jsonl"],
+                       "watchdog_enabled": False}},
+    }}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config, mesh=mesh)
+    if cfgv.get("load_dir"):
+        engine.load_checkpoint(cfgv["load_dir"])
+
+    def batches():
+        # step-keyed data: after a shrink rewinds the counter, the
+        # engine redraws and this yields the rewound step's batch again
+        while True:
+            r = np.random.default_rng(1000 + engine.global_steps)
+            x = r.standard_normal(({batch}, {hidden})).astype(np.float32)
+            y = (np.arange({batch}) % {hidden}).astype(np.int32)
+            yield (x, y)
+
+    it = batches()
+    total = int(cfgv["steps"])
+    save_at = int(cfgv.get("save_step", 0))
+    gate_at = cfgv.get("gate_step")
+    loss = None
+    while engine.global_steps < total:
+        if gate_at is not None and engine.global_steps == int(gate_at):
+            # victim: hold this step until the leader's checkpoint is
+            # verified on disk, so the kill lands AFTER a resumable state
+            latest = os.path.join(cfgv["gate_dir"], "latest")
+            t0 = time.monotonic()
+            while (not os.path.exists(latest)
+                   and time.monotonic() - t0 < 240.0):
+                time.sleep(0.2)
+        loss = engine.train_batch(data_iter=it)
+        if engine.global_steps == save_at and cfgv.get("ckpt_dir"):
+            engine.save_checkpoint(cfgv["ckpt_dir"])
+        print("STEP", engine.global_steps, float(np.asarray(loss)),
+              flush=True)
+        if cfgv.get("step_sleep"):
+            # pace the run so a mid-run fault lands mid-run: without
+            # this, tiny-model steps finish before the victim dies
+            time.sleep(float(cfgv["step_sleep"]))
+    led = engine.telemetry.ledger if engine.telemetry else None
+    print("RESULT " + json.dumps({{
+        "final_step": engine.global_steps,
+        "final_loss": float(np.asarray(loss)),
+        "mesh_devices": len(engine.mesh.devices.flatten()),
+        "status": (engine.recovery_manager.status()
+                   if engine.recovery_manager else None),
+        "conservation": led.conservation() if led else None,
+        "comm_recovery_s": (led.snapshot()["categories"].get(
+            "comm_recovery", 0.0) if led else None),
+    }}), flush=True)
+    engine.close()
+    print("WORKER_DONE", flush=True)
+""").format(repo=REPO_ROOT, hidden=HIDDEN, batch=BATCH)
+
+
+def _spawn(tmp_path, rank, world, cfgv, plan=None, rdv="rdv", extra=None):
+    script = tmp_path / "worker.py"
+    if not script.exists():
+        script.write_text(WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DS_RECOVERY_RANK"] = str(rank)
+    env["DS_RECOVERY_WORLD"] = str(world)
+    env["DS_RECOVERY_DIR"] = str(tmp_path / rdv)
+    env.pop("DS_FAULT_PLAN", None)
+    if plan is not None:
+        env["DS_FAULT_PLAN"] = json.dumps(plan)
+    cfgv = dict(cfgv, jsonl=str(tmp_path / f"rank{rank}.jsonl"))
+    if "ckpt_base" in cfgv:
+        # per-rank checkpoint dirs: the runs are redundant SPMD, so only
+        # the leader's dir matters (the shrink plan's load_dir), and
+        # per-rank dirs keep concurrent saves from racing on one tree
+        cfgv["ckpt_dir"] = os.path.join(cfgv.pop("ckpt_base"),
+                                        f"rank{rank}")
+    if extra:
+        cfgv.update(extra)
+    return subprocess.Popen(
+        [sys.executable, str(script), json.dumps(cfgv)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _reap(procs, timeout_s):
+    """Wait for every process within one shared deadline; kill and fail
+    on stragglers (the zero-hung-processes guarantee)."""
+    deadline = time.monotonic() + timeout_s
+    out = {}
+    hung = []
+    for rank, p in procs.items():
+        left = deadline - time.monotonic()
+        try:
+            stdout, stderr = p.communicate(timeout=max(left, 1.0))
+            out[rank] = (p.returncode, stdout, stderr)
+        except subprocess.TimeoutExpired:
+            hung.append(rank)
+            p.kill()
+            p.communicate()
+    assert not hung, f"hung worker ranks: {hung}"
+    return out
+
+
+def _result(stdout):
+    for line in stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return None
+
+
+def _tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestKillThenShrink:
+    def test_sigkill_rank_shrinks_to_half_world_with_loss_parity(
+            self, tmp_path):
+        world, total, save_at = 4, 7, 2
+        leader_ck = str(tmp_path / "ck" / "rank0")
+        cfgv = {"steps": total, "save_step": save_at,
+                "ckpt_base": str(tmp_path / "ck"), "step_sleep": 1.5}
+        t0 = time.monotonic()
+        procs = {}
+        for rank in range(world):
+            plan, extra = None, None
+            if rank == 3:
+                # the victim: SIGKILL at the 4th step boundary, gated so
+                # it cannot die before the leader checkpointed step 2
+                plan = [{"site": "train.step", "action": "kill",
+                         "signal": int(signal.SIGKILL), "on_hit": 4}]
+                extra = {"gate_step": save_at, "gate_dir": leader_ck}
+            procs[rank] = _spawn(tmp_path, rank, world, cfgv,
+                                 plan=plan, extra=extra)
+        res = _reap(procs, timeout_s=560)
+        elapsed = time.monotonic() - t0
+
+        rc = {rank: r[0] for rank, r in res.items()}
+        stderr_tail = {r: res[r][2][-2000:] for r in res}
+        # victim died by signal; excluded live rank left with the
+        # reserved mesh-shrink code; kept ranks finished clean
+        assert rc[3] == -signal.SIGKILL, stderr_tail
+        assert rc[2] == 114, stderr_tail
+        assert rc[0] == 0 and rc[1] == 0, stderr_tail
+
+        results = {r: _result(res[r][1]) for r in (0, 1)}
+        for rank, r in results.items():
+            assert r is not None, res[rank][1][-2000:]
+            assert r["final_step"] == total
+            assert r["mesh_devices"] == 2          # shrunk mesh
+            st = r["status"]
+            assert st["ladder_state"] == "recovered"
+            assert st["recoveries"] >= 1
+            assert st["world_size"] == 2
+            assert 3 in st["quarantined_ranks"]
+            assert st["last_abort"]["cause"] == "rank_dead"
+        # survivors agree with each other bit-for-bit
+        assert results[0]["final_loss"] == results[1]["final_loss"]
+
+        # excluded rank dropped the coordinator-confirmed marker for the
+        # elastic agent
+        from deepspeed_tpu.comm.recovery import consume_recovery_marker
+        marker = consume_recovery_marker(str(tmp_path / "rdv"))
+        assert marker is not None and marker["cause"] == "mesh_shrink"
+
+        # ...and the survivors' loss matches a clean world=2 run resumed
+        # from the same checkpoint (pure SPMD: same mesh shape, same
+        # step-keyed data, same math).  Separate rendezvous — this run
+        # must not see the incident's leftovers.
+        clean = _spawn(tmp_path, 0, 1,
+                       {"steps": total, "mesh_devices": 2,
+                        "load_dir": leader_ck}, rdv="rdv_clean")
+        crc = _reap({"clean": clean}, timeout_s=240)["clean"]
+        assert crc[0] == 0, crc[2][-2000:]
+        clean_res = _result(crc[1])
+        assert clean_res["final_step"] == total
+        assert clean_res["final_loss"] == results[0]["final_loss"]
+
+        # bounded recovery: the whole incident fit the run's wall clock
+        assert elapsed < 560
+
+        # the offline report over the survivors' artifacts passes the
+        # acceptance gates: warm recovery, bounded latency
+        tool = _tool("recovery_report")
+        paths = [str(tmp_path / "rank0.jsonl"), str(tmp_path / "rank1.jsonl")]
+        assert tool.main(paths + ["--max-recovery-s", "420",
+                                  "--forbid-cold-restart"]) == 0
+
+
+class TestWedgeThenRetry:
+    def test_wedged_collective_recovers_in_place_with_conservation(
+            self, tmp_path):
+        world, total = 2, 3
+        cfgv = {"steps": total, "deadline": WEDGE_DEADLINE_S}
+        # both ranks wedge their first staged collective: both deadlines
+        # expire, the first abort doc wins, both converge on the barrier
+        # and retry in place — deterministic, no liveness race between a
+        # wedged rank and a peer that finishes early
+        plan = [{"site": "comm.collective", "action": "wedge",
+                 "on_hit": 1, "times": 1}]
+        procs = {rank: _spawn(tmp_path, rank, world, cfgv, plan=plan)
+                 for rank in range(world)}
+        res = _reap(procs, timeout_s=560)
+        rc = {rank: r[0] for rank, r in res.items()}
+        assert rc == {0: 0, 1: 0}, {r: res[r][2][-2000:] for r in res}
+
+        results = {r: _result(res[r][1]) for r in res}
+        for rank in res:
+            r = results[rank]
+            assert r is not None, res[rank][1][-2000:]
+            assert r["final_step"] == total
+            assert r["mesh_devices"] == 8      # NO shrink happened
+            st = r["status"]
+            assert st["ladder_state"] == "recovered"
+            assert st["incidents"] >= 1
+            assert st["recoveries"] >= 1
+            assert st["quarantined_ranks"] == []
+            assert st["world_size"] == world
+            assert st["last_abort"]["cause"] == "collective_timeout"
+            # the wedged deadline wait booked into comm_recovery, and
+            # the ledger still conserves wall time within 1%
+            assert r["comm_recovery_s"] >= WEDGE_DEADLINE_S * 0.5
+            cons = r["conservation"]
+            assert cons["ok"], (rank, cons)
+            assert cons["frac_err"] <= 0.01
+        # identical SPMD runs: recovery must not have forked the math
+        assert results[0]["final_loss"] == results[1]["final_loss"]
+
+        # report gates over both ranks' artifacts: in-place recovery only
+        tool = _tool("recovery_report")
+        paths = [str(tmp_path / f"rank{r}.jsonl") for r in res]
+        rep_out = str(tmp_path / "report.json")
+        assert tool.main(paths + ["--max-recovery-s", "420",
+                                  "--forbid-cold-restart",
+                                  "--json", rep_out]) == 0
+        rep = json.loads(open(rep_out).read())
+        assert rep["summary"]["rung_counts"].get("retry", 0) >= 2
+        assert rep["summary"]["rung_counts"].get("shrink", 0) == 0

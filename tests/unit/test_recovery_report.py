@@ -1,0 +1,169 @@
+"""CLI tests for ``tools/recovery_report.py``: per-incident timeline
+reconstruction from the recovery ladder's telemetry records, latency
+percentiles, the ``--max-recovery-s`` / ``--forbid-cold-restart`` gates,
+and the uniform ``--json`` envelope with 0/1/2 exits.  No jax."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _retry_incident(cause="collective_timeout", recovery_s=2.2, step=7):
+    """One wedge incident resolved in place by the retry rung."""
+    return [
+        {"kind": "collective_abort", "schema": 1, "incident": 1,
+         "cause": cause, "step": step,
+         "detail": {"op": "all_gather", "deadline_s": 2.0}},
+        {"kind": "recovery_retry", "schema": 1, "rung": "retry",
+         "attempt": 0, "detail": {}},
+        {"kind": "recovery_resume", "schema": 1, "rung": "retry",
+         "recovery_s": recovery_s, "booked_s": recovery_s},
+    ]
+
+
+def _shrink_incident(recovery_s=9.5):
+    """A dead-rank incident resolved by the elastic mesh shrink."""
+    return [
+        {"kind": "collective_abort", "schema": 1, "incident": 2,
+         "cause": "rank_dead", "step": 12, "detail": {"dead_ranks": [5]}},
+        {"kind": "mesh_shrink", "schema": 1, "rung": "shrink",
+         "attempt": 0, "detail": {"new_world": 4, "dead_ranks": [5]}},
+        {"kind": "recovery_resume", "schema": 1, "rung": "shrink",
+         "recovery_s": recovery_s, "booked_s": recovery_s},
+    ]
+
+
+def _cold_restart_incident():
+    """Retries exhausted → restart rung (the process exits mid-ladder,
+    so there is no terminal resume record)."""
+    return [
+        {"kind": "collective_abort", "schema": 1, "incident": 3,
+         "cause": "collective_timeout", "step": 30, "detail": {}},
+        {"kind": "recovery_retry", "schema": 1, "rung": "retry",
+         "attempt": 0, "detail": {}},
+        {"kind": "recovery_retry", "schema": 1, "rung": "retry",
+         "attempt": 1, "detail": {}},
+        {"kind": "recovery_restart", "schema": 1, "rung": "restart",
+         "attempt": 2, "detail": {}},
+    ]
+
+
+class TestFold:
+    def test_timeline_and_percentiles(self, tmp_path, capsys):
+        tool = _tool("recovery_report")
+        # a training step record interleaved: must be ignored, not break
+        # incident spans
+        path = _write_jsonl(tmp_path / "r0.jsonl",
+                            _retry_incident()
+                            + [{"kind": "step", "step": 8, "loss": 1.0}]
+                            + _shrink_incident())
+        assert tool.main([path]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["tool"] == "recovery_report"
+        s = rep["summary"]
+        assert s["incidents"] == 2 and s["recovered"] == 2
+        assert s["rung_counts"] == {"retry": 1, "shrink": 1}
+        assert s["causes"] == ["collective_timeout", "rank_dead"]
+        assert s["recovery_latency_s"]["max"] == pytest.approx(9.5)
+        assert s["recovery_latency_s"]["p50"] == pytest.approx(2.2)
+        shrink = rep["timeline"][1]
+        assert shrink["cause"] == "rank_dead"
+        assert shrink["rungs"][0]["detail"]["new_world"] == 4
+
+    def test_multi_rank_files_concatenate(self, tmp_path, capsys):
+        tool = _tool("recovery_report")
+        p0 = _write_jsonl(tmp_path / "r0.jsonl", _retry_incident())
+        p1 = _write_jsonl(tmp_path / "r1.jsonl",
+                          _retry_incident(recovery_s=3.0))
+        assert tool.main([p0, p1]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["summary"]["incidents"] == 2
+        assert {i["source"] for i in rep["timeline"]} == {p0, p1}
+
+    def test_open_incident_counted(self, tmp_path, capsys):
+        tool = _tool("recovery_report")
+        path = _write_jsonl(tmp_path / "r0.jsonl", _cold_restart_incident())
+        assert tool.main([path]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["summary"]["open"] == 1
+        assert rep["summary"]["cold_restarts"] == 1
+
+
+class TestGates:
+    def test_max_recovery_s(self, tmp_path, capsys):
+        tool = _tool("recovery_report")
+        path = _write_jsonl(tmp_path / "r0.jsonl",
+                            _retry_incident() + _shrink_incident())
+        assert tool.main([path, "--max-recovery-s", "30"]) == 0
+        capsys.readouterr()                      # drop the passing report
+        assert tool.main([path, "--max-recovery-s", "5"]) == 1
+        rep = json.loads(capsys.readouterr().out)
+        assert not rep["gates"]["max_recovery_s"]["ok"]
+        assert rep["gates"]["max_recovery_s"]["value"] == pytest.approx(9.5)
+
+    def test_forbid_cold_restart_passes_on_warm_ladder(self, tmp_path):
+        tool = _tool("recovery_report")
+        path = _write_jsonl(tmp_path / "r0.jsonl",
+                            _retry_incident() + _shrink_incident())
+        assert tool.main([path, "--forbid-cold-restart"]) == 0
+
+    def test_forbid_cold_restart_fails_on_restart_rung(self, tmp_path,
+                                                       capsys):
+        tool = _tool("recovery_report")
+        path = _write_jsonl(tmp_path / "r0.jsonl",
+                            _retry_incident() + _cold_restart_incident())
+        assert tool.main([path, "--forbid-cold-restart"]) == 1
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["gates"]["forbid_cold_restart"]["value"] == 1
+
+    def test_forbid_cold_restart_fails_on_terminal_failure(self, tmp_path):
+        tool = _tool("recovery_report")
+        recs = [
+            {"kind": "collective_abort", "schema": 1, "incident": 1,
+             "cause": "rank_dead", "step": 1, "detail": {}},
+            {"kind": "recovery_failed", "schema": 1,
+             "reason": "ladder_exhausted", "recovery_s": 40.0},
+        ]
+        path = _write_jsonl(tmp_path / "r0.jsonl", recs)
+        assert tool.main([path, "--forbid-cold-restart"]) == 1
+
+
+class TestEnvelope:
+    def test_json_out_mirrors_stdout(self, tmp_path, capsys):
+        tool = _tool("recovery_report")
+        path = _write_jsonl(tmp_path / "r0.jsonl", _retry_incident())
+        out = tmp_path / "rep.json"
+        assert tool.main([path, "--json", str(out)]) == 0
+        stdout = capsys.readouterr().out
+        assert json.loads(stdout) == json.loads(out.read_text())
+        assert json.loads(stdout)["report_schema"] == 1
+
+    def test_missing_file_exit_2(self, tmp_path):
+        tool = _tool("recovery_report")
+        assert tool.main([str(tmp_path / "nope.jsonl")]) == 2
+
+    def test_no_recovery_records_exit_2(self, tmp_path):
+        tool = _tool("recovery_report")
+        path = _write_jsonl(tmp_path / "r0.jsonl",
+                            [{"kind": "step", "step": 1, "loss": 2.0}])
+        assert tool.main([path]) == 2

@@ -291,6 +291,16 @@ class TestLockDisciplinePass:
         assert any(p.startswith("deepspeed_tpu/runtime/offload/")
                    for p in rel)
 
+    def test_comm_recovery_plane_is_in_scope(self):
+        """The recovery coordinator and the bounded-collective worker are
+        lock-heavy host threading — the lock-discipline sweep must cover
+        the comm tree."""
+        files = lock_discipline.checked_files(REPO_ROOT)
+        rel = {os.path.relpath(f, REPO_ROOT).replace(os.sep, "/")
+               for f in files}
+        assert "deepspeed_tpu/comm/recovery.py" in rel
+        assert "deepspeed_tpu/comm/bounded.py" in rel
+
     def test_seeded_tiering_shape_violations(self, tmp_path):
         """A miniature of the kv_tiering lock protocol with the two bugs
         the pass exists to catch: a store read (blocking D2H/NVMe wait)

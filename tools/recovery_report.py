@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Collective recovery report — offline incident forensics over the
+recovery ladder's telemetry records.
+
+Folds the ``collective_abort`` / ``recovery_retry`` / ``mesh_shrink`` /
+``recovery_restart`` / ``recovery_resume`` / ``recovery_failed``
+records of a telemetry JSONL set (one file per rank — pass them all)
+into per-incident timelines: what opened the incident (deadline expiry,
+peer abort, rank death), which ladder rungs ran, how it resolved, and
+the end-to-end recovery latency.  Aggregates recovery-latency
+percentiles (p50/p95/max) and rung counts across every incident.  Same
+family as ``tools/collective_report.py``: forensics over run artifacts,
+no jax, standard library only.
+
+Usage::
+
+    python tools/recovery_report.py JSONL [JSONL ...]
+        [--max-recovery-s X] [--forbid-cold-restart] [--json OUT]
+
+``--max-recovery-s`` fails (exit 1) when any resolved incident took
+longer than the bound; ``--forbid-cold-restart`` fails when any
+incident escalated past in-place recovery (a ``recovery_restart`` rung
+or a terminal ``recovery_failed``) — the gate for "the ladder must have
+recovered without a cold restart".  Exit 2 on usage errors (unreadable
+file, no recovery records).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(name):
+    """Load a telemetry module by file path so the tool keeps its no-jax
+    property; package import is the fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "deepspeed_tpu", "telemetry", name + ".py")
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_telemetry_" + name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    import importlib
+    return importlib.import_module("deepspeed_tpu.telemetry." + name)
+
+
+_stats = _load("stats")
+load_records = _stats.load_records
+
+ABORT = "collective_abort"
+RUNGS = {"recovery_retry": "retry", "mesh_shrink": "shrink",
+         "recovery_restart": "restart", "recovery_rung": "other"}
+RESUME = "recovery_resume"
+FAILED = "recovery_failed"
+KINDS = {ABORT, RESUME, FAILED} | set(RUNGS)
+
+
+def fold_incidents(path, records):
+    """→ list of incident dicts reconstructed from one rank's record
+    stream.  Records are ordered within a file (the hub appends), so an
+    incident is the span from a ``collective_abort`` to its terminal
+    ``recovery_resume`` / ``recovery_failed``; an abort with no terminal
+    record is an *open* incident (the rank exited mid-ladder — e.g. a
+    mesh-shrink exclusion or a restart rung taking the process down)."""
+    incidents, cur = [], None
+    for rec in records:
+        kind = rec.get("kind")
+        if kind not in KINDS:
+            continue
+        if kind == ABORT:
+            if cur is not None:
+                incidents.append(cur)        # previous never resolved
+            cur = {"source": path,
+                   "incident": rec.get("incident"),
+                   "cause": rec.get("cause"),
+                   "step": rec.get("step"),
+                   "detail": rec.get("detail") or {},
+                   "rungs": [], "outcome": "open", "recovery_s": None}
+            continue
+        if cur is None:
+            # rung/terminal with no abort in this file (torn head) —
+            # synthesize so nothing is silently dropped
+            cur = {"source": path, "incident": None, "cause": None,
+                   "step": None, "detail": {}, "rungs": [],
+                   "outcome": "open", "recovery_s": None}
+        if kind in RUNGS:
+            cur["rungs"].append({"rung": RUNGS[kind],
+                                 "attempt": rec.get("attempt"),
+                                 "detail": rec.get("detail") or {}})
+        elif kind == RESUME:
+            cur["outcome"] = "recovered"
+            cur["resume_rung"] = rec.get("rung")
+            cur["recovery_s"] = rec.get("recovery_s")
+            cur["booked_s"] = rec.get("booked_s")
+            incidents.append(cur)
+            cur = None
+        elif kind == FAILED:
+            cur["outcome"] = "failed"
+            cur["reason"] = rec.get("reason")
+            cur["recovery_s"] = rec.get("recovery_s")
+            incidents.append(cur)
+            cur = None
+    if cur is not None:
+        incidents.append(cur)
+    return incidents
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile (matches the live monitor's convention)."""
+    if not sorted_vals:
+        return None
+    import math
+    i = max(int(math.ceil(q * len(sorted_vals))) - 1, 0)
+    return sorted_vals[min(i, len(sorted_vals) - 1)]
+
+
+def summarize(incidents):
+    rung_counts = {}
+    for inc in incidents:
+        for r in inc["rungs"]:
+            rung_counts[r["rung"]] = rung_counts.get(r["rung"], 0) + 1
+    lat = sorted(float(i["recovery_s"]) for i in incidents
+                 if i["recovery_s"] is not None)
+    cold = [i for i in incidents
+            if i["outcome"] == "failed"
+            or any(r["rung"] == "restart" for r in i["rungs"])]
+    return {
+        "incidents": len(incidents),
+        "recovered": sum(1 for i in incidents
+                         if i["outcome"] == "recovered"),
+        "failed": sum(1 for i in incidents if i["outcome"] == "failed"),
+        "open": sum(1 for i in incidents if i["outcome"] == "open"),
+        "cold_restarts": len(cold),
+        "rung_counts": rung_counts,
+        "causes": sorted({i["cause"] for i in incidents if i["cause"]}),
+        "recovery_latency_s": {
+            "n": len(lat),
+            "p50": _pct(lat, 0.50),
+            "p95": _pct(lat, 0.95),
+            "max": lat[-1] if lat else None,
+        },
+    }
+
+
+def load_fold(paths):
+    """→ (incident list, error or None): each file folded independently
+    (incident streams are per-rank), then concatenated."""
+    incidents = []
+    for path in paths:
+        recs, err = load_records(path)
+        if err:
+            return None, err
+        incidents.extend(fold_incidents(path, recs))
+    if not incidents:
+        return None, ("no recovery records (was the run started with "
+                      "elasticity.recovery_enabled and telemetry on?)")
+    return incidents, None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Recovery-ladder incident report over per-rank "
+                    "telemetry JSONL")
+    ap.add_argument("paths", nargs="+",
+                    help="telemetry JSONL file(s), one per rank")
+    ap.add_argument("--max-recovery-s", type=float, default=None,
+                    help="fail (exit 1) if any resolved incident took "
+                         "longer than this")
+    ap.add_argument("--forbid-cold-restart", action="store_true",
+                    help="fail (exit 1) if any incident escalated past "
+                         "in-place recovery (restart rung or terminal "
+                         "failure)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    incidents, err = load_fold(args.paths)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    summary = summarize(incidents)
+    report = {"paths": list(args.paths), "summary": summary,
+              "timeline": incidents}
+    gates = {}
+    if args.max_recovery_s is not None:
+        worst = summary["recovery_latency_s"]["max"]
+        gates["max_recovery_s"] = {
+            "limit": args.max_recovery_s,
+            "value": worst,
+            "ok": worst is None or worst <= args.max_recovery_s,
+        }
+    if args.forbid_cold_restart:
+        gates["forbid_cold_restart"] = {
+            "limit": 0,
+            "value": summary["cold_restarts"],
+            "ok": summary["cold_restarts"] == 0,
+        }
+    report["ok"] = all(g["ok"] for g in gates.values())
+    return _stats.finalize_report("recovery_report", report, gates=gates,
+                                  json_out=args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
