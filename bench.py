@@ -47,6 +47,13 @@ parses the final line — and every record persisted to
 * ``multichip``: the offloaded layered step on an 8-device mesh (re-execs
   onto 8 virtual host devices when fewer are attached).
   value = samples/sec; vs_baseline = offloaded / in-HBM on the same mesh.
+* ``autotune``: the closed-loop autotuner (``autotuning/loop.py``) over a
+  small (<= 6 candidate) search space, each trial a short profiled
+  subprocess on an 8-virtual-device CPU mesh scored from its
+  ``EFFICIENCY.json`` goodput ledger.
+  value       = the best trial's goodput_frac.
+  vs_baseline = best goodput_frac / the seed-default (unpatched) config's
+                goodput_frac on the same workload.
 
 Timing methodology: the driver may run this through a remote-tunneled TPU
 runtime where ``jax.block_until_ready`` returns before device execution
@@ -55,7 +62,8 @@ dispatch chains of different lengths, each ended by a single scalar fetch
 (the only true sync point), and the per-step cost is the difference — the
 fixed round-trip and dispatch overheads cancel.
 
-Env knobs: BENCH_MODE (all|train|bert|decode|comm|serve|offload|multichip),
+Env knobs: BENCH_MODE
+(all|train|bert|decode|comm|serve|offload|multichip|autotune),
 BENCH_MODEL (gpt2|gpt2-medium|
 gpt2-large|gpt2-xl | bert-base|bert-large), BENCH_SEQ (default 512 train /
 128 bert), BENCH_MICRO (default 8 train / 32 bert), BENCH_STEPS (default
@@ -985,6 +993,81 @@ def bench_offload():
     return rec
 
 
+def bench_autotune():
+    """Closed-loop autotune rung: a bounded search (<= 6 candidates over
+    ZeRO stage / micro-batch / qwZ) where every trial is a short
+    profiled subprocess on an 8-virtual-device CPU mesh scored from its
+    goodput ledger, plus the unpatched seed-default config as the
+    baseline anchor.
+
+    value       = best trial's goodput_frac (productive wall fraction).
+    vs_baseline = best goodput_frac / seed-default goodput_frac — what
+                  the closed loop bought over just running the defaults.
+
+    The record carries the pruned-vs-run accounting and the winning
+    patch so the driver's detail artifact doubles as a provenance
+    trail."""
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.autotuning.loop import ClosedLoopAutotuner
+
+    steps = int(os.environ.get("BENCH_AUTOTUNE_STEPS", "4"))
+    base = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "autotuning": {
+            # 2 (stage 1) + 4 (stage 3 x qwZ) = 6 candidates
+            "search_space": {"zero_stage": (1, 3),
+                             "micro_batch": (2, 8),
+                             "qwz": (False, True)},
+            "trial": {"steps": steps, "hidden_dim": 32},
+            "trial_timeout_s": float(
+                os.environ.get("BENCH_AUTOTUNE_TRIAL_TIMEOUT_S", "300")),
+        },
+    }
+    trial_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    tmp = tempfile.mkdtemp(prefix="bench_autotune_")
+    try:
+        loop = ClosedLoopAutotuner(base, results_dir=tmp,
+                                   trial_env=trial_env, world=8)
+        loop.tune(baseline=True)
+        best = loop.best
+        base_gf = (loop.baseline.score.goodput_frac
+                   if loop.baseline is not None and loop.baseline.scored
+                   else None)
+        best_gf = best.score.goodput_frac if best is not None else 0.0
+        counts = loop.manifest()["counts"]
+        rec = {
+            "metric": "closed-loop autotune best goodput_frac "
+                      f"({counts['run']} trials over "
+                      f"{counts['candidates']} candidates, "
+                      "8-virtual-device CPU mesh)",
+            "value": round(best_gf, 4),
+            "unit": "goodput fraction",
+            "vs_baseline": (round(best_gf / base_gf, 4)
+                            if base_gf else None),
+            "baseline_goodput_frac": (round(base_gf, 4)
+                                      if base_gf else None),
+            "candidates": counts["candidates"],
+            "pruned": counts["pruned"],
+            "run": counts["run"],
+            "scored": counts["scored"],
+            "degraded": counts["degraded"],
+            "best_patch": dict(best.patch) if best is not None else None,
+            "best_knobs": dict(best.knobs) if best is not None else None,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(rec))
+    return rec
+
+
 def bench_multichip():
     """Dedicated multichip rung: the offloaded layered step on an 8-device
     mesh (the smallest topology where the fsdp collectives, the prefetch
@@ -1345,7 +1428,8 @@ def main():
             run_rung(mode, {"train": bench_train, "bert": bench_bert,
                             "decode": bench_decode, "comm": bench_comm,
                             "serve": bench_serve, "offload": bench_offload,
-                            "multichip": bench_multichip}[mode])
+                            "multichip": bench_multichip,
+                            "autotune": bench_autotune}[mode])
         except RungCancelled as e:
             print(json.dumps({"metric": f"{mode} CANCELLED",
                               "error": str(e)[:200]}))
@@ -1364,6 +1448,7 @@ def main():
                      ("serve", bench_serve),
                      ("offload", bench_offload),
                      ("multichip", bench_multichip),
+                     ("autotune", bench_autotune),
                      ("train", bench_train)):
         try:
             detail[name] = run_rung(name, fn)

@@ -1,11 +1,28 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.autotuning.fingerprint import (StaleTuningError,
+                                                  environment_fingerprint)
+from deepspeed_tpu.autotuning.fingerprint import check as check_fingerprint
+from deepspeed_tpu.autotuning.fingerprint import check_engine
+from deepspeed_tpu.autotuning.loop import ClosedLoopAutotuner
 from deepspeed_tpu.autotuning.scheduler import (CONFIG_PATH_ENV,
                                                 METRIC_PATH_ENV,
-                                                ResourceManager, write_metrics)
+                                                ResourceManager,
+                                                TrialResult, TrialScheduler,
+                                                write_metrics)
+from deepspeed_tpu.autotuning.scoring import (TrialScore, better,
+                                              score_from_efficiency)
+from deepspeed_tpu.autotuning.space import (KNOB_CATALOG, Candidate,
+                                            SearchSpace, apply_patch,
+                                            patch_diff)
 from deepspeed_tpu.autotuning.tuner import (BaseTuner, GridSearchTuner,
                                             ModelBasedTuner, RandomTuner,
                                             RidgeCostModel)
 
 __all__ = ["Autotuner", "ResourceManager", "write_metrics", "BaseTuner",
            "GridSearchTuner", "RandomTuner", "ModelBasedTuner",
-           "RidgeCostModel", "METRIC_PATH_ENV", "CONFIG_PATH_ENV"]
+           "RidgeCostModel", "METRIC_PATH_ENV", "CONFIG_PATH_ENV",
+           "ClosedLoopAutotuner", "TrialScheduler", "TrialResult",
+           "TrialScore", "better", "score_from_efficiency",
+           "SearchSpace", "Candidate", "KNOB_CATALOG", "apply_patch",
+           "patch_diff", "environment_fingerprint", "check_fingerprint",
+           "check_engine", "StaleTuningError"]

@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional
 from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
                                             RandomTuner)
 from deepspeed_tpu.autotuning.utils import gen_combinations
+from deepspeed_tpu.runtime import memory_model
 from deepspeed_tpu.utils.logging import log_dist
 
 DEFAULT_MIN_MBS = 1
@@ -63,13 +64,11 @@ class Autotuner:
         stage's sharding: stage>=1 shards optimizer+masters, stage>=3 also
         params.  Gradients (4P fp32 accumulators, sharded at stage>=2) are
         included; activations are workload-dependent and probed, not
-        estimated."""
+        estimated.  The arithmetic lives in ``runtime/memory_model.py`` —
+        the SAME model behind ``offload/policy.py:plan_residency``, so the
+        bytes pruned on are the bytes the engine's budget gate enforces."""
         p = int(self.model_info.get("num_params", 0))
-        dp = self.dp_world
-        params_mem = 2 * p / (dp if stage >= 3 else 1)
-        grads_mem = 4 * p / (dp if stage >= 2 else 1)
-        opt_mem = 12 * p / (dp if stage >= 1 else 1)
-        return int(params_mem + grads_mem + opt_mem)
+        return memory_model.stage_state_bytes(p, stage, self.dp_world)
 
     def _feasible_stages(self) -> List[int]:
         stages = self.zero_stages or [0, 1, 2, 3]

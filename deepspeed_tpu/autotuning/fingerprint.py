@@ -1,0 +1,154 @@
+"""Retune fingerprint: is an emitted patch still valid for THIS run?
+
+A tuned config is hardware- and model-specific (ZeRO++ and the Frontier
+recipe both show the winning quantization bits / partition placement /
+micro-batch flip with the pod and the model).  So the closed loop stamps
+every emitted ``ds_config_patch.json`` with a fingerprint of the
+environment it was tuned on — pod shape (device count, platform, mesh
+axes, process count), model dims (``model_info``), and the jax version —
+and :func:`check` compares it at engine init: a patch tuned on a
+different environment triggers a retune warning (default) or an outright
+:class:`StaleTuningError` refusal (``autotuning.stale_policy: refuse``).
+"""
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+FINGERPRINT_SCHEMA = 1
+
+#: emitted-patch artifact filename inside a results dir
+PATCH_BASENAME = "ds_config_patch.json"
+
+
+class StaleTuningError(RuntimeError):
+    """The applied autotuner patch was tuned on a different environment
+    and ``autotuning.stale_policy`` is ``refuse``."""
+
+
+def environment_fingerprint(mesh_shape: Optional[Dict[str, int]] = None,
+                            model_dims: Optional[Dict[str, Any]] = None,
+                            extra: Optional[Dict[str, Any]] = None) -> Dict:
+    """Fingerprint of the live environment: pod shape, model dims, jax
+    version.  ``model_dims`` is whatever the caller can state about the
+    model (``num_params`` at minimum); comparison is per present key, so
+    a richer producer never invalidates a leaner consumer."""
+    import jax
+    devices = jax.devices()
+    fp = {
+        "schema": FINGERPRINT_SCHEMA,
+        "pod": {
+            "device_count": int(jax.device_count()),
+            "process_count": int(jax.process_count()),
+            "platform": devices[0].platform if devices else "unknown",
+            "mesh_shape": {str(k): int(v)
+                           for k, v in (mesh_shape or {}).items()},
+        },
+        "model": dict(model_dims or {}),
+        "jax_version": jax.__version__,
+    }
+    if extra:
+        fp["extra"] = dict(extra)
+    return fp
+
+
+def fingerprint_digest(fp: Dict) -> str:
+    """Stable short digest of a fingerprint document."""
+    blob = json.dumps(fp, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def compare(stored: Dict, current: Dict) -> List[str]:
+    """Mismatch descriptions between a stored and the current
+    fingerprint.  Leaf-wise over the keys BOTH sides carry (an absent
+    key is unknowable, not stale); empty list = still valid."""
+    out: List[str] = []
+
+    def _walk(a, b, path):
+        if isinstance(a, dict) and isinstance(b, dict):
+            for k in sorted(set(a) & set(b)):
+                _walk(a[k], b[k], f"{path}.{k}" if path else str(k))
+            return
+        if a != b:
+            out.append(f"{path}: tuned on {a!r}, now {b!r}")
+
+    stored = {k: v for k, v in (stored or {}).items() if k != "schema"}
+    current = {k: v for k, v in (current or {}).items() if k != "schema"}
+    _walk(stored, current, "")
+    return out
+
+
+def resolve_patch_path(autotuning_cfg: Dict) -> Optional[str]:
+    """The patch artifact a config points at: ``autotuning.patch``
+    directly, else ``autotuning.results_dir``/ds_config_patch.json."""
+    cfg = autotuning_cfg or {}
+    if cfg.get("patch"):
+        return str(cfg["patch"])
+    if cfg.get("results_dir"):
+        return os.path.join(str(cfg["results_dir"]), PATCH_BASENAME)
+    return None
+
+
+def check(patch_doc_or_path,
+          current_fp: Dict,
+          policy: str = "warn") -> List[str]:
+    """Compare a patch artifact's stored fingerprint against the current
+    environment.  Returns the mismatch list; ``policy`` is ``off`` (skip),
+    ``warn`` (log each mismatch, default) or ``refuse`` (raise
+    :class:`StaleTuningError`).  A missing/unreadable artifact is a
+    warning, never a refusal — the run simply has nothing to validate."""
+    if policy == "off":
+        return []
+    if isinstance(patch_doc_or_path, str):
+        try:
+            with open(patch_doc_or_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning(
+                f"autotune.check: cannot read patch artifact "
+                f"{patch_doc_or_path}: {e}")
+            return []
+    else:
+        doc = patch_doc_or_path or {}
+    stored = doc.get("fingerprint")
+    if not isinstance(stored, dict):
+        logger.warning("autotune.check: patch artifact carries no "
+                       "fingerprint; cannot validate staleness")
+        return []
+    mismatches = compare(stored, current_fp)
+    if not mismatches:
+        return []
+    detail = "; ".join(mismatches)
+    if policy == "refuse":
+        raise StaleTuningError(
+            "autotuned config is stale — the environment changed since the "
+            f"tune ({detail}); re-run the autotuner or set "
+            "autotuning.stale_policy to 'warn'")
+    logger.warning(
+        f"autotune.check: tuned config may be stale ({detail}); consider "
+        "re-running the autotuner")
+    return mismatches
+
+
+def check_engine(autotuning_cfg: Dict,
+                 mesh_shape: Dict[str, int],
+                 params=None,
+                 num_params: Optional[int] = None) -> List[str]:
+    """The engine-init hook: when the ds_config applies a tuned patch
+    (``autotuning.patch`` / ``autotuning.results_dir``), validate its
+    fingerprint against the live mesh + model + jax version."""
+    path = resolve_patch_path(autotuning_cfg)
+    if path is None:
+        return []
+    if num_params is None and params is not None:
+        import jax
+        num_params = int(sum(int(x.size) for x in jax.tree.leaves(params)))
+    dims = {}
+    if num_params is not None:
+        dims["num_params"] = int(num_params)
+    current = environment_fingerprint(mesh_shape=mesh_shape, model_dims=dims)
+    policy = str((autotuning_cfg or {}).get("stale_policy", "warn"))
+    return check(path, current, policy=policy)

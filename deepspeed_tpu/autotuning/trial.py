@@ -1,0 +1,96 @@
+"""Default trial runner: one short profiled run of a candidate config.
+
+Launched by :class:`~deepspeed_tpu.autotuning.scheduler.TrialScheduler`
+as ``python -m deepspeed_tpu.autotuning.trial``; the candidate's full
+ds_config arrives via ``DS_AUTOTUNING_CONFIG`` with telemetry forced on,
+so the engine's close drops the ``EFFICIENCY.json`` the loop scores.
+The workload is deliberately tiny and synthetic — the trial exists to
+exercise the CONFIG (sharding, prefetch, quantized collectives, fused
+kernels) under the goodput ledger, not to converge a model:
+
+* ``autotuning.trial.steps`` optimizer steps (default 6) of
+  :class:`~deepspeed_tpu.models.simple.SimpleModel` with
+  ``autotuning.trial.hidden_dim`` (default 64);
+* deterministic data (seeded numpy) so two trials differ only by their
+  config;
+* the inherited ``DS_FAULT_PLAN`` fires inside the engine exactly as in
+  production — a plan that wedges the step leaves the trial hung for
+  the scheduler's watchdog to reap, which is the point of the wedged
+  e2e.
+
+The legacy ``DS_AUTOTUNING_METRIC_PATH`` contract is honored too: the
+runner drops a ``metrics.json`` with raw throughput so the seed-era
+``ResourceManager``/``Autotuner`` path can drive this same runner.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def run_trial(config: dict) -> dict:
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel
+
+    trial_cfg = dict((config.get("autotuning") or {}).get("trial") or {})
+    steps = int(trial_cfg.get("steps", 6))
+    hidden = int(trial_cfg.get("hidden_dim", 64))
+    seed = int(trial_cfg.get("seed", 0))
+
+    cfg = json.loads(json.dumps(config))
+    # the candidate patch sets the micro-batch; the global batch is then
+    # derived from the live world size (the mesh knob may change it), so
+    # a stale train_batch_size from the base config must not conflict
+    if "train_micro_batch_size_per_gpu" in cfg:
+        cfg.pop("train_batch_size", None)
+    cfg.setdefault("optimizer", {"type": "Adam", "params": {"lr": 1e-3}})
+
+    model = SimpleModel(hidden_dim=hidden)
+    params = model.init_params(jax.random.key(seed))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg)
+
+    gas = engine.gradient_accumulation_steps()
+    rows = max(engine.train_batch_size() // max(gas, 1), 1)
+    rng = np.random.default_rng(seed)
+    data = [(rng.standard_normal((rows, hidden)).astype(np.float32),
+             np.zeros((rows,), np.int32)) for _ in range(4)]
+
+    t0 = time.monotonic()
+    while engine.global_steps < steps:
+        x, y = data[engine.micro_steps % len(data)]
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+    wall = time.monotonic() - t0
+    engine.close()
+
+    samples = engine.global_samples
+    return {"throughput": (samples / wall) if wall > 0 else 0.0,
+            "steps": engine.global_steps, "wall_s": wall}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cfg_path = argv[0] if argv else os.environ.get("DS_AUTOTUNING_CONFIG")
+    if not cfg_path:
+        print("trial: no config (pass a path or set DS_AUTOTUNING_CONFIG)",
+              file=sys.stderr)
+        return 2
+    with open(cfg_path) as f:
+        config = json.load(f)
+    metrics = run_trial(config)
+    metric_path = os.environ.get("DS_AUTOTUNING_METRIC_PATH")
+    if metric_path:
+        from deepspeed_tpu.autotuning.scheduler import write_metrics
+        write_metrics(metric_path, metrics)
+    print("TRIAL_DONE " + json.dumps(metrics), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -487,6 +487,17 @@ class DeepSpeedEngine:
                 gas_boundary_resolution=ec.gas_boundary_resolution,
                 layer_name=ec.layer_name, layer_num=ec.layer_num)
 
+        # ---- autotuned-config staleness check --------------------------- #
+        # When the ds_config applies an emitted autotuner patch, validate
+        # the patch's environment fingerprint (pod shape, model dims, jax
+        # version) against the live run: warn by default, refuse when
+        # autotuning.stale_policy is "refuse".
+        at_cfg = self._config.autotuning_config or {}
+        if at_cfg.get("patch") or at_cfg.get("results_dir"):
+            from deepspeed_tpu.autotuning import fingerprint as at_fp
+            at_fp.check_engine(at_cfg, mesh_shape=dict(self.mesh.shape),
+                               params=self.state.params)
+
         # ---- compiled programs (built lazily per batch structure) ------ #
         self._grad_step = None
         self._eval_step = None

@@ -29,6 +29,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.runtime import memory_model
+
 
 class HBMBudgetError(RuntimeError):
     """The configured step cannot fit the HBM budget under any available
@@ -122,45 +124,39 @@ def plan_residency(params,
     optimizer as ``opt_slots`` fp32 copies of the param shard (Adam m+v).
     """
     world = max(1, int(world))
-    notes = []
 
     param_total = tree_bytes(params)                      # fp32 master
     gathered = tree_bytes(params, itemsize=compute_itemsize)
-    shard = param_total // world
-    grads_shard = param_total // world                    # fp32 grad acc
-    if opt_state is not None:
-        opt_shard = tree_bytes(opt_state) // world
-    else:
-        opt_shard = opt_slots * shard
-        notes.append(f"optimizer sized as {opt_slots}x fp32 param shard")
-
-    # plain stage 3: everything gathered at once + shards + grads + opt
-    plain_peak = gathered + shard + grads_shard + opt_shard
-
     blocks, rest, n_layer = _block_and_rest(params)
     depth = max(1, int(prefetch_depth))
-    if blocks is not None and n_layer > 0:
-        block_gathered = tree_bytes(blocks, itemsize=compute_itemsize)
-        per_slice = block_gathered // n_layer
-        rest_gathered = tree_bytes(rest, itemsize=compute_itemsize)
-        window = rest_gathered + min(depth + 1, n_layer) * per_slice
-    else:
-        window = gathered
-        notes.append("model not stacked: no layer window to offload")
 
-    window_peak = window + grads_shard + shard
-    if optimizer_tier == "hbm":
-        window_peak += opt_shard
+    # the peak arithmetic lives in runtime/memory_model.py — the SAME
+    # model the autotuner prunes candidate configs with, so a config the
+    # tuner admits is a config this gate admits (parity-tested)
+    peaks = memory_model.step_peaks(
+        param_bytes=param_total,
+        gathered_bytes=gathered,
+        world=world,
+        opt_bytes=(tree_bytes(opt_state) if opt_state is not None else None),
+        opt_slots=opt_slots,
+        block_gathered_bytes=(tree_bytes(blocks, itemsize=compute_itemsize)
+                              if blocks is not None and n_layer > 0 else 0),
+        rest_gathered_bytes=(tree_bytes(rest, itemsize=compute_itemsize)
+                             if blocks is not None and n_layer > 0 else 0),
+        n_layer=n_layer,
+        prefetch_depth=depth,
+        optimizer_tier=optimizer_tier)
+    notes = list(peaks.notes)
     if params_tier == "hbm":
         notes.append("params_tier=hbm: window plan assumes host residency")
 
     plan = ResidencyPlan(
         budget_bytes=int(budget_bytes),
-        plain_peak_bytes=int(plain_peak),
-        window_peak_bytes=int(window_peak),
-        fits_plain=plain_peak <= budget_bytes,
-        fits_window=(window_peak <= budget_bytes
-                     and blocks is not None and n_layer > 0
+        plain_peak_bytes=peaks.plain_peak_bytes,
+        window_peak_bytes=peaks.window_peak_bytes,
+        fits_plain=peaks.plain_peak_bytes <= budget_bytes,
+        fits_window=(peaks.window_peak_bytes <= budget_bytes
+                     and peaks.has_window
                      and params_tier != "hbm"),
         n_layer=n_layer,
         prefetch_depth=depth,

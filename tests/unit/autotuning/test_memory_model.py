@@ -1,0 +1,124 @@
+"""The unified memory model and its two call sites, pinned together on
+gpt2 shapes: the bytes the autotuner prunes candidate configs with MUST
+equal the bytes the offload planner's HBM-budget gate enforces at engine
+init — ``runtime/memory_model.py`` is the single home of the arithmetic,
+and this parity test is what keeps the call sites from drifting apart
+again."""
+
+import jax
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.models.gpt import gpt_config, init_gpt_params
+from deepspeed_tpu.runtime import memory_model
+from deepspeed_tpu.runtime.offload.policy import plan_residency, tree_bytes
+
+WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def gpt2_shapes():
+    """The real gpt2 parameter tree as shape/dtype carriers (no
+    allocation) — scan_layers so the stacked ``blocks`` subtree exists."""
+    cfg = gpt_config("gpt2", n_positions=256, scan_layers=True)
+    shapes = jax.eval_shape(lambda r: init_gpt_params(cfg, r),
+                            jax.random.key(0))
+    return cfg, shapes
+
+
+def _num_params(tree):
+    import math
+    return sum(int(math.prod(x.shape) or 1) for x in jax.tree.leaves(tree))
+
+
+class TestStageStateBytes:
+    def test_stage_sharding_ladder(self):
+        """Higher stages strictly shrink per-device state on a real
+        world: stage 1 shards optimizer+masters, 2 adds grads, 3 adds
+        params."""
+        p = 124_000_000
+        sizes = [memory_model.stage_state_bytes(p, s, WORLD)
+                 for s in (0, 1, 2, 3)]
+        assert sizes[0] > sizes[1] > sizes[2] > sizes[3]
+        # stage 0 is the full 2P + 4P + 12P layout
+        assert sizes[0] == (2 + 4 + 12) * p
+        # stage 3 shards everything
+        assert sizes[3] == ((2 + 4 + 12) * p) // WORLD
+
+    def test_world_of_one_is_stage_invariant(self):
+        p = 1_000_000
+        assert len({memory_model.stage_state_bytes(p, s, 1)
+                    for s in (0, 1, 2, 3)}) == 1
+
+    def test_autotuner_call_site_delegates(self, gpt2_shapes):
+        """Autotuner.get_instantiation_memory_required_per_device IS
+        stage_state_bytes on the gpt2 parameter count."""
+        _, shapes = gpt2_shapes
+        p = _num_params(shapes)
+        at = Autotuner({"autotuning": {"model_info": {"num_params": p}}},
+                       run_fn=lambda cfg: 0.0, dp_world=WORLD)
+        for stage in (0, 1, 2, 3):
+            assert (at.get_instantiation_memory_required_per_device(stage)
+                    == memory_model.stage_state_bytes(p, stage, WORLD))
+
+
+class TestStepPeaksParity:
+    """analytic_step_peaks (the pruner, counts only) vs plan_residency
+    (the engine gate, live shape tree) on the SAME gpt2 model."""
+
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    @pytest.mark.parametrize("opt_tier", ["hbm", "cpu"])
+    def test_gpt2_peaks_agree_exactly(self, gpt2_shapes, depth, opt_tier):
+        cfg, shapes = gpt2_shapes
+        p = _num_params(shapes)
+        blk = _num_params(shapes["blocks"])
+
+        plan = plan_residency(shapes, None, budget_bytes=1 << 40,
+                              world=WORLD, compute_itemsize=2,
+                              prefetch_depth=depth, params_tier="cpu",
+                              optimizer_tier=opt_tier)
+        peaks = memory_model.analytic_step_peaks(
+            p, WORLD, compute_itemsize=2, block_params=blk,
+            n_layer=cfg.n_layer, prefetch_depth=depth,
+            optimizer_tier=opt_tier)
+
+        assert peaks.plain_peak_bytes == plan.plain_peak_bytes
+        assert peaks.window_peak_bytes == plan.window_peak_bytes
+        assert peaks.has_window and plan.n_layer == cfg.n_layer
+
+    def test_window_beats_plain_on_gpt2(self, gpt2_shapes):
+        cfg, shapes = gpt2_shapes
+        peaks = memory_model.analytic_step_peaks(
+            _num_params(shapes), WORLD, compute_itemsize=2,
+            block_params=_num_params(shapes["blocks"]),
+            n_layer=cfg.n_layer, prefetch_depth=2)
+        assert peaks.window_peak_bytes < peaks.plain_peak_bytes
+
+    def test_offloaded_optimizer_leaves_the_window(self, gpt2_shapes):
+        cfg, shapes = gpt2_shapes
+        p = _num_params(shapes)
+        kw = dict(compute_itemsize=2,
+                  block_params=_num_params(shapes["blocks"]),
+                  n_layer=cfg.n_layer, prefetch_depth=2)
+        hbm = memory_model.analytic_step_peaks(p, WORLD,
+                                               optimizer_tier="hbm", **kw)
+        cpu = memory_model.analytic_step_peaks(p, WORLD,
+                                               optimizer_tier="cpu", **kw)
+        assert (hbm.window_peak_bytes - cpu.window_peak_bytes
+                == hbm.opt_shard_bytes)
+        # plain stage 3 keeps the optimizer shard either way
+        assert hbm.plain_peak_bytes == cpu.plain_peak_bytes
+
+    def test_unstacked_tree_has_no_window(self):
+        peaks = memory_model.analytic_step_peaks(1_000_000, WORLD,
+                                                 n_layer=0, block_params=0)
+        assert not peaks.has_window
+        assert any("not stacked" in n for n in peaks.notes)
+
+    def test_tree_bytes_matches_count_arithmetic(self, gpt2_shapes):
+        """The count-based pruner input equals the tree-based gate
+        input: fp32 masters are exactly 4 bytes per parameter."""
+        _, shapes = gpt2_shapes
+        assert tree_bytes(shapes) == 4 * _num_params(shapes)
+        assert (tree_bytes(shapes, itemsize=2)
+                == 2 * _num_params(shapes))

@@ -1,0 +1,249 @@
+"""Subprocess lifecycle of the closed loop's trial scheduler.
+
+The properties under test are the ones a long search depends on:
+
+* a wedged trial is killed at its deadline and its WHOLE process group
+  is reaped — a SIGTERM-ignoring leader plus its grandchild must both be
+  gone afterwards (no zombies, no lingering pgid eating the machine);
+* a crashed trial is recorded **degraded**, never silently dropped —
+  every launched trial leaves a provenance row;
+* ``tuner_early_stopping`` fires at its EXACT boundary — the Nth
+  consecutive non-improving trial is the last one launched.
+
+All trials here are stub python scripts (no jax, no engine) so the
+lifecycle is tested in isolation and in milliseconds.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.autotuning.loop import ClosedLoopAutotuner
+from deepspeed_tpu.autotuning.scheduler import (DEGRADED, SCORED,
+                                                TrialResult, TrialScheduler)
+from deepspeed_tpu.autotuning.scoring import TrialScore
+
+
+def _script(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+def _gone(pid, timeout_s=8.0):
+    """True once ``pid`` has fully left the process table (reaped by us
+    or by init after reparenting) — a lingering zombie keeps its /proc
+    entry and fails this."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if not os.path.exists(f"/proc/{pid}"):
+            return True
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().split(")")[-1].split()[0]
+        except OSError:
+            return True
+        if state == "Z" and not _is_our_child(pid):
+            # reparented zombie: init reaps it momentarily
+            time.sleep(0.05)
+            continue
+        time.sleep(0.05)
+    return not os.path.exists(f"/proc/{pid}")
+
+
+def _is_our_child(pid):
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return int(f.read().split(")")[-1].split()[1]) == os.getpid()
+    except (OSError, ValueError):
+        return False
+
+
+# A conserving ledger document good enough for score_from_efficiency.
+def _ledger(goodput=0.9, wall=2.0, steps=4):
+    return {"ledger": {
+        "categories": {"productive_step": wall * goodput},
+        "goodput_frac": goodput, "mfu": 0.3, "wall_s": wall,
+        "steps": steps, "productive_steps": steps,
+        "conservation": {"ok": True}, "mode": "train"}}
+
+
+class TestReapedTimeout:
+    def test_sigterm_ignoring_group_is_fully_reaped(self, tmp_path):
+        """Leader ignores SIGTERM and spawns a SIGTERM-ignoring
+        grandchild; the deadline must still clear BOTH from the process
+        table and record the trial degraded."""
+        script = _script(tmp_path, "wedge.py", """
+            import os, signal, subprocess, sys, time
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            trial_dir = os.path.dirname(os.environ["DS_AUTOTUNING_CONFIG"])
+            with open(os.path.join(trial_dir, "leader.pid"), "w") as f:
+                f.write(str(os.getpid()))
+            code = ("import os, signal, time;"
+                    "signal.signal(signal.SIGTERM, signal.SIG_IGN);"
+                    "open(os.environ['GC_PID_FILE'], 'w')"
+                    ".write(str(os.getpid()));"
+                    "time.sleep(120)")
+            env = dict(os.environ)
+            env["GC_PID_FILE"] = os.path.join(trial_dir, "grandchild.pid")
+            subprocess.Popen([sys.executable, "-c", code], env=env)
+            time.sleep(120)
+        """)
+        sched = TrialScheduler(str(tmp_path / "trials"),
+                               cmd=[sys.executable, script],
+                               timeout_s=2.0, reap_grace_s=0.5)
+        t0 = time.monotonic()
+        res = sched.run_trial("wedged", {})
+        took = time.monotonic() - t0
+
+        assert res.status == DEGRADED
+        assert res.timed_out
+        assert "deadline" in res.error
+        # the watchdog, not the 120 s sleep, ended the trial
+        assert took < 30
+
+        trial_dir = res.trial_dir
+        leader = int(open(os.path.join(trial_dir, "leader.pid")).read())
+        grandchild = int(open(os.path.join(trial_dir,
+                                           "grandchild.pid")).read())
+        assert _gone(leader), "leader leaked past the group reap"
+        assert _gone(grandchild), "grandchild leaked past the group reap"
+        # the whole pgid is gone — a new signal has nobody to hit
+        with pytest.raises(ProcessLookupError):
+            os.killpg(leader, 0)
+        assert sched.status() == {"scored": 0, "degraded": 1, "running": 0}
+
+    def test_crashed_trial_is_degraded_not_dropped(self, tmp_path):
+        script = _script(tmp_path, "crash.py", """
+            import sys
+            sys.exit(3)
+        """)
+        sched = TrialScheduler(str(tmp_path / "trials"),
+                               cmd=[sys.executable, script], timeout_s=30)
+        res = sched.run_trial("crasher", {}, knobs={"zero_stage": 3})
+        assert res.status == DEGRADED and res.rc == 3
+        assert "rc=3" in res.error
+        # the provenance row survives with its knobs — never dropped
+        assert [r.name for r in sched.results] == ["crasher"]
+        assert sched.results[0].knobs == {"zero_stage": 3}
+        assert sched.status()["degraded"] == 1
+
+    def test_trial_without_efficiency_json_is_degraded(self, tmp_path):
+        script = _script(tmp_path, "silent.py", """
+            import sys
+            sys.exit(0)
+        """)
+        sched = TrialScheduler(str(tmp_path / "trials"),
+                               cmd=[sys.executable, script], timeout_s=30)
+        res = sched.run_trial("silent", {})
+        assert res.status == DEGRADED and res.rc == 0
+        assert "EFFICIENCY.json" in res.error
+
+    def test_scored_trial_reads_real_artifact(self, tmp_path):
+        """A trial that drops a conserving EFFICIENCY.json at the path
+        the scheduler forced into its config scores cleanly."""
+        script = _script(tmp_path, "good.py", """
+            import json, os
+            cfg = json.load(open(os.environ["DS_AUTOTUNING_CONFIG"]))
+            path = cfg["telemetry"]["efficiency_json_path"]
+            doc = json.loads(%r)
+            json.dump(doc, open(path, "w"))
+        """ % json.dumps(_ledger(goodput=0.87)))
+        sched = TrialScheduler(str(tmp_path / "trials"),
+                               cmd=[sys.executable, script], timeout_s=30)
+        res = sched.run_trial("good", {"train_micro_batch_size_per_gpu": 2})
+        assert res.status == SCORED
+        assert res.score.goodput_frac == pytest.approx(0.87)
+        # the forced telemetry block landed in the written ds_config
+        assert res.ds_config["telemetry"]["enabled"] is True
+        assert res.ds_config["telemetry"]["goodput"] is True
+
+    def test_nonconserving_ledger_is_degraded(self, tmp_path):
+        doc = _ledger(goodput=0.99)
+        doc["ledger"]["conservation"] = {"ok": False}
+        script = _script(tmp_path, "drift.py", """
+            import json, os
+            cfg = json.load(open(os.environ["DS_AUTOTUNING_CONFIG"]))
+            json.dump(json.loads(%r),
+                      open(cfg["telemetry"]["efficiency_json_path"], "w"))
+        """ % json.dumps(doc))
+        sched = TrialScheduler(str(tmp_path / "trials"),
+                               cmd=[sys.executable, script], timeout_s=30)
+        res = sched.run_trial("drift", {})
+        assert res.status == DEGRADED
+        assert "conservation" in res.error
+        # the (untrusted) score is kept for the manifest, but not ranked
+        assert res.score is not None and not res.scored
+
+
+# --------------------------------------------------------------------------- #
+# Early-stopping boundary in the loop, with a scripted fake scheduler.
+# --------------------------------------------------------------------------- #
+
+
+class _FakeScheduler:
+    """Deterministic stand-in: goodput per trial comes from a script."""
+
+    def __init__(self, goodputs):
+        self.goodputs = list(goodputs)
+        self.launched = []
+
+    def run_trial(self, name, ds_config, extra_env=None, patch=None,
+                  knobs=None):
+        self.launched.append(name)
+        gf = self.goodputs[len(self.launched) - 1]
+        score = TrialScore(goodput_frac=gf, mfu=0.1, step_time_s=1.0,
+                           wall_s=4.0, steps=4, productive_steps=4,
+                           conservation_ok=True)
+        return TrialResult(name=name, status=SCORED, patch=dict(patch or {}),
+                           knobs=dict(knobs or {}), rc=0, score=score)
+
+
+def _loop(tmp_path, goodputs, early_stopping, num_trials=50, n_cands=8):
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "autotuning": {
+               "search_space": {"micro_batch": list(range(1, n_cands + 1))},
+               "tuner_early_stopping": early_stopping,
+               "tuner_num_trials": num_trials,
+               "results_dir": str(tmp_path / "results")}}
+    fake = _FakeScheduler(goodputs)
+    return ClosedLoopAutotuner(cfg, scheduler=fake), fake
+
+
+class TestEarlyStoppingBoundary:
+    def test_stops_exactly_at_the_boundary(self, tmp_path):
+        """First trial improves; with tuner_early_stopping=3 exactly 3
+        more non-improving trials run — trial 5 is never launched."""
+        loop, fake = _loop(tmp_path, [0.9, 0.5, 0.5, 0.5, 0.95, 0.99],
+                           early_stopping=3)
+        best = loop.tune()
+        assert len(fake.launched) == 4          # 1 improving + exactly 3 flat
+        assert best is not None
+        assert best.score.goodput_frac == pytest.approx(0.9)
+
+    def test_one_below_boundary_keeps_searching(self, tmp_path):
+        """Same goodput trace, early_stopping=4: the run at the would-be
+        cutoff goes ahead, finds the 0.95, and the search resets."""
+        loop, fake = _loop(tmp_path, [0.9, 0.5, 0.5, 0.5, 0.95, 0.4, 0.4,
+                                      0.4],
+                           early_stopping=4)
+        best = loop.tune()
+        # improvement at trial 5 reset the counter; 3 more flat trials
+        # exhaust the 8 candidates without re-triggering the stop
+        assert len(fake.launched) == 8
+        assert best.score.goodput_frac == pytest.approx(0.95)
+
+    def test_zero_disables_early_stopping(self, tmp_path):
+        loop, fake = _loop(tmp_path, [0.9] + [0.1] * 7, early_stopping=0)
+        loop.tune()
+        assert len(fake.launched) == 8
+
+    def test_num_trials_caps_launches(self, tmp_path):
+        loop, fake = _loop(tmp_path, [0.5] * 8, early_stopping=0,
+                           num_trials=2)
+        loop.tune()
+        assert len(fake.launched) == 2

@@ -476,6 +476,87 @@ class TestStalePragmaPass:
 
 
 # --------------------------------------------------------------------------- #
+# PR 19: the autotuner's trial-scoring path joins the zero-sync roots and
+# the scheduler bookkeeping joins the lock-discipline sweep
+# --------------------------------------------------------------------------- #
+
+class TestAutotuningStaticAnalysis:
+    def test_trial_scoring_scopes_are_guarded(self):
+        """The closed loop's scoring module (whole file) and search body
+        are in the zero-sync roster — candidate ranking must stay pure
+        host-side JSON arithmetic."""
+        scopes = set(zero_sync.CHECKED_SCOPES)
+        assert ("deepspeed_tpu/autotuning/scoring.py", None) in scopes
+        assert ("deepspeed_tpu/autotuning/loop.py", "tune") in scopes
+
+    def test_seeded_sync_in_scoring_path_is_flagged(self, tmp_path):
+        """A seeded violation in a tune()-style loop — scoring a trial
+        off a live engine's device values instead of its EFFICIENCY.json
+        artifact — is caught."""
+        sf, _ = _scan(tmp_path, (
+            "class Loop:\n"
+            "    def tune(self, engine):\n"
+            "        gf = float(engine.ledger_goodput)\n"
+            "        wall = engine.wall_s.item()\n"
+            "        return gf / wall\n"))
+        msgs = [m for _, m in zero_sync.scope_violations(sf, "tune")]
+        assert len(msgs) == 2, msgs
+        assert any("float()" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+
+    def test_live_scoring_path_is_clean(self):
+        """The real scoring.py (modulo its JSON-scalar pragmas) and
+        loop.tune() pass the zero-sync check."""
+        ctx = core.Context()
+        sf = ctx.scan("deepspeed_tpu/autotuning/scoring.py",
+                      for_pass="zero-sync")
+        out = [(ln, m) for ln, m in zero_sync.scope_violations(sf, None)
+               if not ctx.sanctioned(sf, ln, "zero-sync")]
+        assert out == []
+        sf = ctx.scan("deepspeed_tpu/autotuning/loop.py",
+                      for_pass="zero-sync")
+        assert list(zero_sync.scope_violations(sf, "tune")) == []
+
+    def test_autotuning_tree_is_in_lock_scope(self):
+        """The trial scheduler's cross-thread bookkeeping put the
+        autotuning tree into the lock-discipline sweep."""
+        files = lock_discipline.checked_files(REPO_ROOT)
+        rel = {os.path.relpath(f, REPO_ROOT).replace(os.sep, "/")
+               for f in files}
+        assert "deepspeed_tpu/autotuning/scheduler.py" in rel
+        assert "deepspeed_tpu/autotuning/loop.py" in rel
+
+    def test_seeded_scheduler_bookkeeping_violations(self, tmp_path):
+        """A miniature TrialScheduler with the two bugs the pass exists
+        to catch: the results table mutated outside its lock, and the
+        child wait (a whole trial's runtime!) issued under it."""
+        sf, ctx = _scan(tmp_path, (
+            "import threading\n"
+            "class Sched:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.results = []  # guarded-by: _lock\n"
+            "    def bad_record(self, r):\n"
+            "        self.results.append(r)\n"
+            "    def bad_wait(self, proc):\n"
+            "        with self._lock:\n"
+            "            return proc.wait(timeout=600)\n"))
+        finds = lock_discipline.check_scanned_file(sf, ctx, set())
+        msgs = [f.message for f in finds]
+        assert len(finds) == 2, msgs
+        assert any("results" in m and "bad_record" in m for m in msgs)
+        assert any("blocking call" in m and "bad_wait" in m for m in msgs)
+
+    def test_live_scheduler_is_clean(self):
+        """The real scheduler.py honors its own lock protocol: guarded
+        dicts only touched under _lock, the trial wait outside it."""
+        ctx = core.Context()
+        sf = ctx.scan("deepspeed_tpu/autotuning/scheduler.py",
+                      for_pass="lock-discipline")
+        assert lock_discipline.check_scanned_file(sf, ctx, set()) == []
+
+
+# --------------------------------------------------------------------------- #
 # the race the triage found: get() vs concurrent put()
 # --------------------------------------------------------------------------- #
 

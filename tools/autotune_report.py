@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Autotune report — the reviewable view of a closed-loop tuning run.
+
+Reads the ``manifest.json`` the closed loop
+(``deepspeed_tpu/autotuning/loop.py``) writes (or the results dir
+containing it) and renders:
+
+* the **leaderboard** — every scored trial ranked the way the loop
+  ranked them (goodput_frac desc, mfu desc, step time asc);
+* the **per-knob marginal table** — for each knob value, the mean
+  goodput_frac over the scored trials that carried it, so a reviewer
+  can see WHICH knob moved the metric before trusting the patch;
+* the **pruned-vs-run accounting** — how many candidates the analytic
+  memory model refused without spending a trial, with reasons.
+
+Same family as ``tools/goodput_report.py``: forensics over run
+artifacts, standard library only, no jax required.
+
+Usage::
+
+    python tools/autotune_report.py MANIFEST_JSON_OR_RESULTS_DIR
+        [--min-goodput-frac X] [--json OUT] [--top N]
+
+Gates: the manifest must contain at least one scored trial and a best
+patch (exit 1 otherwise); ``--min-goodput-frac`` fails (exit 1) when
+the best trial's goodput_frac falls below the bound.  Exit 2 on usage
+errors (unreadable/malformed manifest).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+MANIFEST_BASENAME = "manifest.json"
+
+
+def _load(rel_parts, modname):
+    """Load a repo module by file path so the tool keeps its no-jax
+    property; package import is the fallback for installed layouts."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, *rel_parts)
+    if os.path.isfile(path):
+        spec = importlib.util.spec_from_file_location(
+            "_ds_tpu_" + modname.replace(".", "_"), path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    import importlib
+    return importlib.import_module(modname)
+
+
+_stats = _load(("deepspeed_tpu", "telemetry", "stats.py"),
+               "deepspeed_tpu.telemetry.stats")
+_scoring = _load(("deepspeed_tpu", "autotuning", "scoring.py"),
+                 "deepspeed_tpu.autotuning.scoring")
+
+
+def load_manifest(path):
+    """→ (manifest dict, error or None); accepts the file or its dir."""
+    if os.path.isdir(path):
+        path = os.path.join(path, MANIFEST_BASENAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable manifest {path}: {e}"
+    if not isinstance(doc, dict) or "trials" not in doc:
+        return None, f"{path}: not an autotune manifest (no trials)"
+    return doc, None
+
+
+def _rank_key(trial):
+    """Rank exactly as the loop did: TrialScore.rank_key over the stored
+    score record (a forward-compatible record falls back to the same
+    triplet by hand)."""
+    s = trial.get("score") or {}
+    try:
+        return _scoring.TrialScore(**s).rank_key()
+    except TypeError:
+        return (-(s.get("goodput_frac") or 0.0), -(s.get("mfu") or 0.0),
+                s.get("step_time_s") if s.get("step_time_s") is not None
+                else float("inf"))
+
+
+def leaderboard(manifest, top=0):
+    scored = [t for t in manifest.get("trials", [])
+              if t.get("status") == "scored" and t.get("score")]
+    scored.sort(key=_rank_key)
+    rows = []
+    for i, t in enumerate(scored):
+        s = t["score"]
+        rows.append({"rank": i + 1, "trial": t["name"],
+                     "goodput_frac": s.get("goodput_frac"),
+                     "mfu": s.get("mfu"),
+                     "step_time_s": s.get("step_time_s"),
+                     "knobs": t.get("knobs", {})})
+    return rows[:top] if top else rows
+
+
+def knob_marginals(manifest):
+    """knob -> value(str) -> {n, mean_goodput_frac} over scored trials."""
+    out = {}
+    for t in manifest.get("trials", []):
+        if t.get("status") != "scored" or not t.get("score"):
+            continue
+        gf = t["score"].get("goodput_frac")
+        if gf is None:
+            continue
+        for knob, value in (t.get("knobs") or {}).items():
+            cell = out.setdefault(knob, {}).setdefault(
+                json.dumps(value, default=str), {"n": 0, "sum": 0.0})
+            cell["n"] += 1
+            cell["sum"] += float(gf)
+    return {knob: {val: {"n": c["n"],
+                         "mean_goodput_frac": c["sum"] / c["n"]}
+                   for val, c in vals.items()}
+            for knob, vals in out.items()}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Closed-loop autotune report over a tuning manifest")
+    ap.add_argument("path", help="manifest.json or the results dir")
+    ap.add_argument("--min-goodput-frac", type=float, default=None,
+                    help="fail (exit 1) if the best trial's goodput_frac "
+                         "falls below this")
+    ap.add_argument("--top", type=int, default=0,
+                    help="truncate the leaderboard to N rows (0 = all)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this file")
+    args = ap.parse_args(argv)
+
+    manifest, err = load_manifest(args.path)
+    if err:
+        print(json.dumps({"error": err}), file=sys.stderr)
+        return 2
+
+    counts = dict(manifest.get("counts") or {})
+    counts.setdefault("pruned", len(manifest.get("pruned", [])))
+    counts.setdefault("run", len(manifest.get("trials", [])))
+    board = leaderboard(manifest, top=args.top)
+    best = manifest.get("best")
+    report = {
+        "path": args.path,
+        "fingerprint_digest": manifest.get("fingerprint_digest"),
+        "counts": counts,
+        "leaderboard": board,
+        "knob_marginals": knob_marginals(manifest),
+        "pruned": [{"name": p.get("name"),
+                    "reason": p.get("prune_reason")}
+                   for p in manifest.get("pruned", [])],
+        "best": best,
+        "baseline": manifest.get("baseline"),
+        "verification": manifest.get("verification"),
+    }
+
+    best_gf = ((best or {}).get("score") or {}).get("goodput_frac")
+    gates = {
+        "has_scored_best": {
+            "limit": 1,
+            "value": len(board),
+            "ok": bool(board) and best_gf is not None,
+        },
+    }
+    if args.min_goodput_frac is not None:
+        gates["min_goodput_frac"] = {
+            "limit": args.min_goodput_frac,
+            "value": best_gf,
+            "ok": (best_gf is not None
+                   and best_gf >= args.min_goodput_frac),
+        }
+    report["ok"] = all(g["ok"] for g in gates.values())
+    return _stats.finalize_report("autotune_report", report, gates=gates,
+                                  json_out=args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,7 @@ from tools.dslint.core import (Context, Finding, LintPass, ScannedFile,
 PASS_NAME = "lock-discipline"
 
 CHECKED_DIRS: Sequence[str] = (
+    "deepspeed_tpu/autotuning",
     "deepspeed_tpu/comm",
     "deepspeed_tpu/runtime/offload",
     "deepspeed_tpu/runtime/swap_tensor",

@@ -74,6 +74,13 @@ CHECKED_SCOPES: Sequence[Tuple[str, Optional[str]]] = (
     ("deepspeed_tpu/telemetry/collective_monitor.py", "begin"),
     ("deepspeed_tpu/telemetry/collective_monitor.py", "end"),
     ("deepspeed_tpu/telemetry/collective_monitor.py", "fingerprint_of"),
+    # autotuner trial-scoring path: candidate ranking runs entirely over
+    # host-side JSON artifacts (EFFICIENCY.json), never live device
+    # values — the whole scoring module plus the closed loop's search
+    # body are zero-sync roots (scoring.py also loads standalone in the
+    # no-jax report CLI, which an accidental jax dependency would break).
+    ("deepspeed_tpu/autotuning/scoring.py", None),
+    ("deepspeed_tpu/autotuning/loop.py", "tune"),
 )
 
 _NUMPY_MODULES = ("np", "numpy")
