@@ -155,13 +155,17 @@ class BoundedCollective:
 
     # -- API ----------------------------------------------------------------- #
 
-    def run(self, fn, *args, op="collective", deadline_s=None, **kwargs):
+    def run(self, fn, *args, op="collective", deadline_s=None,
+            noun="collective", **kwargs):
         """Execute ``fn(*args, **kwargs)`` under the deadline.
 
         Resolution order for the bound: explicit ``deadline_s`` argument,
         the instance default, the ``DS_COLLECTIVE_TIMEOUT_S`` env.  With
         no bound configured the call runs inline on the caller thread —
         zero overhead, natural tracebacks, exactly the pre-PR behavior.
+        ``noun`` labels the bounded work in the timeout message — the
+        serving engine bounds compiled *step* dispatches through the same
+        machinery and must not report them as collectives.
         """
         bound = deadline_s
         if bound is None:
@@ -182,8 +186,8 @@ class BoundedCollective:
             self.timeouts += 1
             rec = self._open_record()
             err = CollectiveTimeout(
-                "collective %r exceeded its %.3fs deadline%s" % (
-                    op, bound,
+                "%s %r exceeded its %.3fs deadline%s" % (
+                    noun, op, bound,
                     (" (open seq=%s op=%s fp=%s)" % (
                         rec["seq"], rec["op"], rec["fp"]) if rec else "")),
                 op=(rec["op"] if rec else op), deadline_s=float(bound),

@@ -49,6 +49,22 @@ class DeepSpeedServingConfig(DeepSpeedConfigModel):
     # ---- prefix cache (serving/prefix_cache.py) --------------------------- #
     prefix_cache: bool = False        # share full prompt blocks, refcounted
     prefix_cache_blocks: int = 0      # pinned-block cap; 0 = unbounded
+    # ---- resilience (README § Serving resilience) -------------------------- #
+    # per-class request deadline (ms from arrival); an expired request is
+    # cancelled at the next step boundary, its blocks freed and its prefill
+    # booked as wasted.  Unset/0 classes have no deadline.
+    deadline_ms: Dict[str, float] = Field(default_factory=dict)
+    # bounded step dispatch (comm/bounded.py): a compiled serve step that
+    # exceeds this raises ServeStepTimeout and triggers in-process
+    # recovery instead of hanging the engine forever.  0 = inline dispatch.
+    serve_step_timeout_s: float = 0.0
+    # adaptive admission ladder (scheduler.AdmissionController): the
+    # oldest-waiting age that trips brownout; 2x trips batch-class shed,
+    # 4x sheds standard too.  0 disables the queue-age signal (the
+    # SLOMonitor TTFT-burn signal still drives the ladder when wired).
+    queue_age_watermark_ms: float = 0.0
+    brownout_max_new_tokens: int = 0  # brownout cap on max_new_tokens; 0 = off
+    shed_recovery_steps: int = 16     # calm step evaluations per rung down
     # ---- numerics / misc ------------------------------------------------- #
     dtype: str = "bfloat16"
     seed: int = 0

@@ -32,16 +32,36 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.comm.bounded import BoundedCollective, CollectiveTimeout
 from deepspeed_tpu.runtime.offload import StagingError
 from deepspeed_tpu.serving.config import DeepSpeedServingConfig
 from deepspeed_tpu.serving.kv_cache import (ArenaExhausted, PagedKVAllocator,
                                             init_arena)
 from deepspeed_tpu.serving.kv_tiering import KVTieringManager
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
-from deepspeed_tpu.serving.scheduler import (DECODE, FINISHED, SLO_PRIORITY,
-                                             Request, ServingScheduler)
+from deepspeed_tpu.serving.scheduler import (DECODE, EXPIRED, FINISHED,
+                                             SHED_LEVELS, SLO_PRIORITY,
+                                             AdmissionController,
+                                             DeadlineExceeded, Request,
+                                             ServingScheduler, ShedError)
 from deepspeed_tpu.telemetry.tracing import get_global_tracer
+from deepspeed_tpu.testing.fault_injection import (FaultInjected, fault_point,
+                                                   release_wedges)
 from deepspeed_tpu.utils.logging import log_dist
+
+
+class ServeStepTimeout(RuntimeError):
+    """A compiled serve step (decode or prefill dispatch) exceeded
+    ``serve_step_timeout_s``.  Raised *after* the engine has recovered
+    in-process (programs re-jitted, arena rebuilt, every in-flight request
+    requeued for recompute) — ``run()``/``result()`` keep driving; a bare
+    ``step()`` caller sees the incident."""
+
+    def __init__(self, message, op=None, deadline_s=None, step=None):
+        super().__init__(message)
+        self.op = op
+        self.deadline_s = deadline_s
+        self.step = step
 
 
 class ServeFuture:
@@ -62,11 +82,33 @@ class ServeFuture:
         """Generated tokens so far (excludes the prompt)."""
         return list(self.request.generated)
 
-    def result(self, max_steps: int = 100_000) -> List[int]:
+    def result(self, max_steps: int = 100_000,
+               timeout_s: Optional[float] = None) -> List[int]:
+        """Drive until this request finishes.  ``timeout_s`` bounds the
+        wait in wall-clock seconds (checked at step boundaries — pair it
+        with ``serve_step_timeout_s`` so a wedged *dispatch* cannot park
+        the caller inside one step forever).  Raises
+        :class:`DeadlineExceeded` if the request's own SLO deadline
+        cancelled it."""
+        deadline = (None if timeout_s is None
+                    else self._engine._clock() + float(timeout_s))
         for _ in range(max_steps):
             if self.done:
                 return self.token_ids
-            self._engine.step()
+            if self.request.state == EXPIRED:
+                raise DeadlineExceeded(
+                    f"request {self.request.rid} missed its "
+                    f"{self.request.slo!r}-class deadline and was cancelled")
+            if deadline is not None and self._engine._clock() >= deadline:
+                raise TimeoutError(
+                    f"request {self.request.rid} unfinished after "
+                    f"{timeout_s}s")
+            try:
+                self._engine.step()
+            except ServeStepTimeout:
+                # the engine already recovered (state requeued for
+                # recompute); keep driving under the same bounds
+                continue
         raise TimeoutError(
             f"request {self.request.rid} unfinished after {max_steps} steps")
 
@@ -107,9 +149,10 @@ class _TieringAdapter:
         n = eng.alloc.blocks_for_tokens(req.spilled_tokens)
         dest = eng.alloc.owned_blocks(req.rid)[:n]
         try:
+            fault_point("serve.restage", rid=req.rid)
             eng._k_pages, eng._v_pages, info = self.mgr.restage(
                 req.rid, eng._k_pages, eng._v_pages, dest)
-        except (KeyError, StagingError) as e:
+        except (KeyError, StagingError, FaultInjected) as e:
             # unreadable/missing chunk: drop the record and recompute —
             # the destructive-evict contract still yields identical tokens
             self.mgr.discard(req.rid)
@@ -224,7 +267,30 @@ class ServingEngine:
         # arena donation = in-place KV update; CPU can't donate (jax warns
         # and copies), so only donate on real accelerators
         donate = (3, 4) if jax.default_backend() != "cpu" else ()
+        self._raw_step_fn = step_fn
+        self._donate = donate
         self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+
+        # ---- resilience plane -------------------------------------------- #
+        self._clock = time.monotonic
+        self.admission = AdmissionController(cfg)
+        # bounded step dispatch: a wedged compiled program raises
+        # ServeStepTimeout instead of parking the engine thread forever.
+        # on_timeout releases fault-injection wedges so the abandoned
+        # worker drains instead of leaking (mirrors comm/recovery.py).
+        self._bounded: Optional[BoundedCollective] = None
+        if cfg.serve_step_timeout_s and cfg.serve_step_timeout_s > 0.0:
+            self._bounded = BoundedCollective(
+                deadline_s=float(cfg.serve_step_timeout_s),
+                on_timeout=lambda err: release_wedges())
+        # phases whose program has already compiled: the first dispatch of
+        # each phase runs inline (unbounded) because XLA compilation is
+        # legitimate work that routinely exceeds a steady-state step
+        # deadline — bounding it would fire a spurious incident at startup
+        self._warm_phases: set = set()
+        self.incident_count = 0
+        self.last_recovery_s = 0.0
+        self._incident: Optional[Dict[str, Any]] = None  # /healthz latch
 
         self._rid_counter = 0
         self._futures: Dict[int, ServeFuture] = {}
@@ -247,6 +313,7 @@ class ServingEngine:
         obs = getattr(telemetry, "obs_server", None)
         if obs is not None:
             obs.add_health_check("serve_arena", self._arena_health)
+            obs.add_health_check("serve_incident", self._incident_health)
         log_dist(
             f"ServingEngine ready: slots={cfg.max_batch_size}, "
             f"arena={cfg.num_blocks}x{cfg.block_size} tok "
@@ -285,6 +352,138 @@ class ServingEngine:
                     out[key] = ts[key]
         return out
 
+    def _incident_health(self):
+        """`/healthz` contribution: unhealthy while a serve incident is
+        latched — a wedged step recovered in-process but the engine has
+        not yet completed a clean step.  The latch clears on the first
+        clean step after recovery."""
+        out = {"ok": self._incident is None,
+               "incidents": self.incident_count,
+               "last_recovery_s": round(self.last_recovery_s, 4)}
+        if self._incident is not None:
+            out.update({k: self._incident[k] for k in ("step", "phase")})
+        return out
+
+    # ---- request lifecycle robustness --------------------------------- #
+    def _expire_deadlines(self):
+        """Cancel every request whose per-class deadline has passed —
+        called at the step boundary, so a cancellation never races a
+        compiled dispatch.  Frees arena blocks + staged tier copies and
+        books the accumulated prefill as wasted compute."""
+        if not self._config.deadline_ms:
+            return
+        now = self._clock()
+        for req in self.sched.expired(now):
+            wasted = req.prefilled
+            self.sched.cancel(req)
+            if self.ledger is not None:
+                self.ledger.note_serve_expired(req.slo, wasted)
+            self._emit("serve_expired", {
+                "rid": req.rid, "slo": req.slo,
+                "age_ms": (now - req.arrival) * 1000.0,
+                "deadline_ms": (req.deadline_at - req.arrival) * 1000.0,
+                "generated": len(req.generated),
+                "wasted_prefill_tokens": wasted,
+            }, step=self.step_count)
+
+    def _update_admission(self):
+        """Advance the shed ladder from the queue-age and TTFT-burn
+        signals; rung changes are telemetered (and gauge-fed via the
+        MetricsSink on flush)."""
+        age = self.sched.oldest_wait_s(self._clock())
+        state = "ok"
+        mon = getattr(self.telemetry, "slo_monitor", None)
+        if mon is not None:
+            try:
+                state = mon.state_for_metric("serve_ttft_ms")
+            except Exception:
+                state = "ok"
+        prev = self.admission.level
+        level = self.admission.evaluate(age, state)
+        if level != prev:
+            self._emit("serve_shed", {
+                "event": "level", "level": level,
+                "from": SHED_LEVELS[prev], "to": self.admission.level_name,
+                "queue_age_ms": age * 1000.0, "ttft_state": state,
+            }, step=self.step_count)
+
+    # ---- bounded dispatch + incident recovery -------------------------- #
+    def _dispatch(self, phase: str, *args):
+        """Run one compiled step under the ``serve_step_timeout_s``
+        deadline (inline when unbounded).  The host materialization of the
+        token row happens *inside* the bounded callable — that device sync
+        is exactly where a wedged program parks the thread.  The first
+        dispatch of each phase (and the first after an incident re-jit)
+        runs inline: it compiles, and compile time is not a wedge."""
+        def work():
+            fault_point("serve.step", step=self.step_count, phase=phase)
+            tokens, kp, vp = self._step_fn(self.params, *args)
+            return np.asarray(tokens), kp, vp
+        if self._bounded is None or phase not in self._warm_phases:
+            out = work()
+            self._warm_phases.add(phase)
+            return out
+        try:
+            return self._bounded.run(work, op=phase, noun="serve step")
+        except CollectiveTimeout as e:
+            raise ServeStepTimeout(
+                f"serve {phase} step {self.step_count} exceeded its "
+                f"{e.deadline_s:.3f}s deadline", op=phase,
+                deadline_s=e.deadline_s, step=self.step_count) from e
+
+    def _recover_incident(self, err: ServeStepTimeout):
+        """In-process recovery from a wedged compiled step: drop the
+        (possibly poisoned) executables and arena, rebuild from allocator
+        + tier metadata, and requeue every in-flight request with
+        ``prefilled=0`` — the preemption recompute contract, so the token
+        streams continue identically.  Spilled host/NVMe copies of
+        *waiting* requests survive (they never touch the device arena).
+        Latches ``/healthz`` unhealthy until the first clean step."""
+        import jax
+        t0 = self._clock()
+        self.incident_count += 1
+        cfg, mcfg = self._config, self.module.cfg
+        self._emit("serve_incident", {
+            "event": "begin", "phase": err.op, "step": self.step_count,
+            "deadline_s": err.deadline_s, "incident": self.incident_count,
+            "in_flight": len(self.sched.active),
+        }, step=self.step_count)
+        if self.ledger is not None:
+            # resident KV is about to be discarded: its prefill recomputes
+            for r in self.sched.active.values():
+                self.ledger.note_wasted_prefill(r.slo, r.prefilled)
+        if self.tiering is not None:
+            # no in-flight copy-ring task may still reference the arena
+            # arrays we are about to drop
+            self.tiering.drain()
+        self._step_fn = jax.jit(self._raw_step_fn,
+                                donate_argnums=self._donate)
+        self._warm_phases.clear()   # fresh jit: first dispatches recompile
+        self.alloc = PagedKVAllocator(cfg.num_blocks, cfg.block_size,
+                                      self.max_blocks_per_seq)
+        self._k_pages, self._v_pages = init_arena(
+            mcfg, cfg.num_blocks, cfg.block_size, dtype=self.dtype)
+        if self.prefix is not None:
+            # cached pins point at pre-incident arena content: rebuild
+            self.prefix = PrefixCache(self.alloc,
+                                      max_blocks=cfg.prefix_cache_blocks)
+            self.sched.prefix_cache = self.prefix
+        requeued = self.sched.requeue_for_recovery(self.alloc)
+        self._incident = {"at": t0, "step": self.step_count,
+                          "phase": err.op}
+        self.last_recovery_s = self._clock() - t0
+        if self.ledger is not None:
+            # the wedge wait (the expired deadline) plus the rebuild are
+            # incident seconds, not productive step time
+            self.ledger.note_comm_recovery(
+                (err.deadline_s or 0.0) + self.last_recovery_s)
+        self._emit("serve_incident", {
+            "event": "recovered", "phase": err.op, "step": self.step_count,
+            "requeued": len(requeued), "lost": 0,
+            "recovery_s": self.last_recovery_s,
+            "deadline_s": err.deadline_s, "incident": self.incident_count,
+        }, step=self.step_count)
+
     def _on_preempt(self, victim: Request):
         if self.ledger is not None and not victim.spilled:
             # eviction without a spill record: the prefill is recomputed
@@ -322,9 +521,22 @@ class ServingEngine:
                 f"{sorted(SLO_PRIORITY)} (a typo here would otherwise "
                 "silently demote the request to 'standard')")
         cfg, mcfg = self._config, self.module.cfg
+        if not self.admission.admit_ok(slo):
+            self._emit("serve_shed", {
+                "event": "rejected", "slo": slo,
+                "level": self.admission.level,
+                "level_name": self.admission.level_name,
+                "queue_depth": len(self.sched.waiting),
+            }, step=self.step_count)
+            raise ShedError(
+                f"admission ladder at {self.admission.level_name!r} is "
+                f"shedding {slo!r}-class requests (retry later or raise "
+                "the class)", slo=slo, level=self.admission.level)
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         assert prompt, "empty prompt"
         mnt = int(max_new_tokens or cfg.max_new_tokens_default)
+        # brownout rung: degrade before rejecting
+        mnt = self.admission.cap_new_tokens(mnt)
         total = len(prompt) + mnt
         if total > mcfg.n_positions:
             raise ValueError(f"prompt+max_new_tokens {total} exceeds "
@@ -337,7 +549,10 @@ class ServingEngine:
                 f"{min(cfg.num_blocks - 1, self.max_blocks_per_seq)}")
         self._rid_counter += 1
         req = Request(rid=self._rid_counter, prompt=prompt,
-                      max_new_tokens=mnt, slo=slo, arrival=time.monotonic())
+                      max_new_tokens=mnt, slo=slo, arrival=self._clock())
+        dl = float((cfg.deadline_ms or {}).get(slo, 0.0) or 0.0)
+        if dl > 0.0:
+            req.deadline_at = req.arrival + dl / 1e3
         self.sched.submit(req)
         fut = ServeFuture(self, req)
         self._futures[req.rid] = fut
@@ -350,34 +565,53 @@ class ServingEngine:
 
     # ------------------------------------------------------------------ #
     def step(self) -> Dict[str, Any]:
-        """One engine step: admit, run one prefill chunk, run one decode
-        step over every decode-ready sequence.  Returns the step stats."""
+        """One engine step: expire deadlines, advance the shed ladder,
+        admit, run one prefill chunk, run one decode step over every
+        decode-ready sequence.  Returns the step stats.  A wedged compiled
+        dispatch raises :class:`ServeStepTimeout` *after* in-process
+        recovery (see :meth:`_recover_incident`)."""
+        self._expire_deadlines()
+        self._update_admission()
         self.sched.admit()
         prefill_tokens = 0
         t_step = time.monotonic() if self.registry is not None else 0.0
-        with self._span("serve.step", step=self.step_count):
-            pf = self.sched.next_prefill()
-            if pf is not None:
-                req, start, n = pf
-                with self._span("serve.prefill", rid=req.rid, start=start,
-                                tokens=n):
-                    self._run_prefill(req, start, n)
-                prefill_tokens = n
-            # growth pass, oldest/strongest first: each decode step writes
-            # one token per sequence, so capacity must exist before the
-            # batch is built; eviction here removes victims from `active`
-            decode = sorted(self.sched.decode_batch(),
-                            key=lambda r: (r.priority, r.admit_seq))
-            for r in decode:
-                if r.state == DECODE:          # not evicted by an earlier r
-                    self.sched.ensure_capacity(r, r.prefilled + 1)
-            decode = self.sched.decode_batch()
-            if decode:
-                t_dec = time.monotonic() if self.registry is not None else 0.0
-                with self._span("serve.decode", batch=len(decode)):
-                    self._run_decode(decode)
-                if self.registry is not None:
-                    self._h_decode.observe((time.monotonic() - t_dec) * 1e3)
+        try:
+            with self._span("serve.step", step=self.step_count):
+                pf = self.sched.next_prefill()
+                if pf is not None:
+                    req, start, n = pf
+                    with self._span("serve.prefill", rid=req.rid, start=start,
+                                    tokens=n):
+                        self._run_prefill(req, start, n)
+                    prefill_tokens = n
+                # growth pass, oldest/strongest first: each decode step
+                # writes one token per sequence, so capacity must exist
+                # before the batch is built; eviction here removes victims
+                # from `active`
+                decode = sorted(self.sched.decode_batch(),
+                                key=lambda r: (r.priority, r.admit_seq))
+                for r in decode:
+                    if r.state == DECODE:      # not evicted by an earlier r
+                        self.sched.ensure_capacity(r, r.prefilled + 1)
+                decode = self.sched.decode_batch()
+                if decode:
+                    t_dec = (time.monotonic() if self.registry is not None
+                             else 0.0)
+                    with self._span("serve.decode", batch=len(decode)):
+                        self._run_decode(decode)
+                    if self.registry is not None:
+                        self._h_decode.observe(
+                            (time.monotonic() - t_dec) * 1e3)
+        except ServeStepTimeout as err:
+            self._recover_incident(err)
+            raise
+        if self._incident is not None:
+            # first clean step after an incident: release the latch
+            self._emit("serve_incident", {
+                "event": "cleared", "phase": self._incident["phase"],
+                "incident_step": self._incident["step"],
+            }, step=self.step_count)
+            self._incident = None
         self.step_count += 1
         if self.ledger is not None:
             self.ledger.on_step(self.step_count,
@@ -386,6 +620,8 @@ class ServingEngine:
         stats = dict(self.sched.stats(), decode_batch=len(decode),
                      prefill_tokens=prefill_tokens,
                      tokens_generated=self.tokens_generated,
+                     shed_level=self.admission.level,
+                     incidents=self.incident_count,
                      elapsed_ms=(time.monotonic() - self._started) * 1000.0)
         if self.tiering is not None:
             stats.update(self.tiering.stats())
@@ -417,28 +653,99 @@ class ServingEngine:
         return stats
 
     def run(self, max_steps: int = 1_000_000) -> int:
-        """Drive until every queued/active request finishes.  Returns the
-        number of steps taken."""
+        """Drive until every queued/active request finishes (expired
+        requests leave the queue by cancellation).  A ServeStepTimeout
+        incident does not abort the drain — the engine recovered before
+        raising, so the loop keeps going; the step bound still applies
+        (wedged attempts count toward it).  Returns the number of
+        completed engine steps."""
         start = self.step_count
+        steps = 0
         while self.sched.has_work:
-            if self.step_count - start >= max_steps:
+            if steps >= max_steps:
                 raise TimeoutError(f"serving drain exceeded {max_steps} steps")
-            self.step()
+            try:
+                self.step()
+            except ServeStepTimeout:
+                pass       # recovered in-process; requests are requeued
+            steps += 1
         return self.step_count - start
 
     def close(self):
-        """Release the tiering backend (staging threads + an owned
-        tempdir); idempotent, and a no-op without tiering."""
+        """Release the resilience + tiering backends: stop the bounded
+        dispatch worker, drain the tiering copy ring, close the staging
+        pool (and an owned tempdir / telemetry hub).  Idempotent."""
         if self._closed:
             return
         self._closed = True
+        if self._bounded is not None:
+            self._bounded.shutdown()
         if self.tiering is not None:
+            self.tiering.drain()
             self.tiering.close()
         if self._owns_telemetry and self.telemetry is not None:
             try:
                 self.telemetry.close()
             except Exception:
                 pass
+
+    # ---- warm restart -------------------------------------------------- #
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready warm-restart state: the scheduler queue + per-request
+        progress — prompts, generated-so-far, remaining deadline — but NOT
+        KV bytes (recompute on restore keeps the snapshot tiny and the
+        token streams identical).  Take it between steps; an elastic-agent
+        relaunch feeds it to :meth:`restore` on a fresh engine."""
+        now = self._clock()
+        in_flight = sorted(
+            list(self.sched.waiting) + list(self.sched.active.values()),
+            key=lambda r: r.submit_seq)
+        reqs = []
+        for r in in_flight:
+            reqs.append({
+                "rid": r.rid,
+                "prompt": [int(t) for t in r.prompt],
+                "generated": [int(t) for t in r.generated],
+                "max_new_tokens": int(r.max_new_tokens),
+                "slo": r.slo,
+                "age_s": now - r.arrival,
+                "deadline_remaining_s": (
+                    None if r.deadline_at is None else r.deadline_at - now),
+                "preemptions": int(r.preemptions),
+            })
+        return {"schema": 1, "requests": reqs,
+                "rid_counter": int(self._rid_counter),
+                "step_count": int(self.step_count)}
+
+    def restore(self, snap: Dict[str, Any]) -> List[ServeFuture]:
+        """Resume a :meth:`snapshot` on this (idle) engine: every request
+        re-enters the waiting queue with ``prefilled=0`` — admission
+        re-prefills prompt + generated-so-far, so greedy decoding
+        continues the identical stream.  Remaining deadlines are
+        re-anchored to this engine's clock (already-expired ones cancel on
+        the first step).  Returns the new futures in submit order."""
+        assert not self.sched.waiting and not self.sched.active, (
+            "restore() needs an idle engine (fresh or fully drained)")
+        now = self._clock()
+        futures = []
+        for d in snap.get("requests", []):
+            req = Request(rid=int(d["rid"]),
+                          prompt=[int(t) for t in d["prompt"]],
+                          max_new_tokens=int(d["max_new_tokens"]),
+                          slo=str(d.get("slo", "standard")),
+                          arrival=now - float(d.get("age_s", 0.0)))
+            req.generated = [int(t) for t in d.get("generated", [])]
+            req.preemptions = int(d.get("preemptions", 0))
+            rem = d.get("deadline_remaining_s")
+            if rem is not None:
+                req.deadline_at = now + float(rem)
+            self.sched.submit(req)
+            fut = ServeFuture(self, req)
+            self._futures[req.rid] = fut
+            futures.append(fut)
+        self._rid_counter = max(self._rid_counter,
+                                int(snap.get("rid_counter", 0)))
+        return futures
 
     # ------------------------------------------------------------------ #
     def _run_prefill(self, req: Request, start: int, n: int):
@@ -451,21 +758,23 @@ class ServingEngine:
         positions = np.asarray([start], np.int32)
         tables = self.alloc.block_table(req.rid)[None]           # [1, MB]
         wb, wo = self.alloc.write_map(req.rid, start, C, n_valid=n)
-        tokens, self._k_pages, self._v_pages = self._step_fn(
-            self.params, jnp.asarray(ids), jnp.asarray(positions),
+        tokens, self._k_pages, self._v_pages = self._dispatch(
+            "prefill", jnp.asarray(ids), jnp.asarray(positions),
             self._k_pages, self._v_pages, jnp.asarray(tables),
             jnp.asarray(wb[None]), jnp.asarray(wo[None]))
         req.prefilled += n
         if req.prefilled >= req.prefill_len:
-            if self.prefix is not None:
+            if self.prefix is not None and not self.admission.brownout:
                 # the prompt's full blocks now hold valid KV: pin them for
-                # later requests sharing this prefix (idempotent re-insert)
+                # later requests sharing this prefix (idempotent re-insert;
+                # paused under brownout — pinning competes with admission
+                # for blocks exactly when the arena is the bottleneck)
                 self.prefix.insert(req.prompt,
                                    self.alloc.owned_blocks(req.rid))
             # the chunk holding the last context token also yields the next
             # token — first-token latency includes no extra decode step
             req.state = DECODE
-            self._append_token(req, int(np.asarray(tokens)[0, n - 1]))
+            self._append_token(req, int(tokens[0, n - 1]))
 
     def _run_decode(self, reqs: List[Request]):
         import jax.numpy as jnp
@@ -482,11 +791,10 @@ class ServingEngine:
             positions[s] = r.prefilled
             tables[s] = self.alloc.block_table(r.rid)
             wb[s], wo[s] = self.alloc.write_map(r.rid, r.prefilled, 1)
-        tokens, self._k_pages, self._v_pages = self._step_fn(
-            self.params, jnp.asarray(ids), jnp.asarray(positions),
+        tokens, self._k_pages, self._v_pages = self._dispatch(
+            "decode", jnp.asarray(ids), jnp.asarray(positions),
             self._k_pages, self._v_pages, jnp.asarray(tables),
             jnp.asarray(wb), jnp.asarray(wo))
-        tokens = np.asarray(tokens)
         for r in reqs:
             r.prefilled += 1          # the fed token's KV is now resident
             self._append_token(r, int(tokens[r.slot, 0]))
@@ -495,9 +803,9 @@ class ServingEngine:
         req.generated.append(tok)
         self.tokens_generated += 1
         if req.first_token_at is None:
-            req.first_token_at = time.monotonic()
+            req.first_token_at = self._clock()
         if req.done(self._config.eos_token_id):
-            req.finished_at = time.monotonic()
+            req.finished_at = self._clock()
             self.sched.finish(req)
             ttft = req.first_token_at - req.arrival
             latency = req.finished_at - req.arrival

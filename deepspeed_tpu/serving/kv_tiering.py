@@ -305,6 +305,14 @@ class KVTieringManager:
                 f"nvme {s['kv_nvme_bytes']}B ({budget}), "
                 f"{s['kv_spilled_seqs']} spilled seqs")
 
+    def drain(self) -> None:
+        """Join every in-flight copy-ring task (spill writes, restage
+        prefetch reads) without closing the backend.  The serving engine
+        calls this before close() and during wedge recovery — after a
+        drain no staged task can still reference the old arena arrays."""
+        if not self._closed:
+            self.staging.drain()
+
     def close(self) -> None:
         """Idempotent shutdown: drain staging, drop an owned tempdir."""
         if self._closed:

@@ -48,10 +48,107 @@ WAITING = "waiting"
 PREFILL = "prefill"
 DECODE = "decode"
 FINISHED = "finished"
+EXPIRED = "expired"      # deadline passed; cancelled at a step boundary
 
 
 class QueueFull(Exception):
     """submit() past ``max_queue`` — shed load at the front door."""
+
+
+class ShedError(Exception):
+    """429-style rejection from the adaptive admission ladder: the engine
+    is shedding this request's SLO class until pressure clears.  Distinct
+    from :class:`QueueFull` (the static bound) so callers can retry-later
+    vs. downshift-class deliberately."""
+
+    def __init__(self, message, slo=None, level=None):
+        super().__init__(message)
+        self.slo = slo
+        self.level = level
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's per-class deadline passed before it finished; it was
+    cancelled at a step boundary and its blocks freed."""
+
+
+# Adaptive admission ladder rungs, mildest first.  ``brownout`` degrades
+# (cap max_new_tokens, pause prefix-cache inserts); the shed rungs reject
+# outright, weakest SLO class first — realtime is never ladder-shed.
+SHED_LEVELS = ("ok", "brownout", "shed_batch", "shed_standard")
+
+
+class AdmissionController:
+    """Pure-host shed ladder over two pressure signals: the TTFT burn
+    state (the PR 13 ``SLOMonitor`` state machine for the
+    ``serve_ttft_ms`` rule) and the oldest-waiting queue age vs. the
+    configured watermark.  Escalation is immediate; de-escalation steps
+    one rung down only after ``shed_recovery_steps`` consecutive calm
+    evaluations — hysteresis, so the ladder doesn't flap at the boundary.
+    """
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        # config is static for the controller's lifetime — coerce once so
+        # the per-step evaluate()/cap path stays free of conversion calls
+        self._watermark_s = float(cfg.queue_age_watermark_ms or 0.0) / 1e3
+        self._recovery_steps = max(int(cfg.shed_recovery_steps), 1)
+        self._brownout_cap = int(cfg.brownout_max_new_tokens or 0)
+        self.level = 0                  # index into SHED_LEVELS
+        self._calm = 0
+        self.shed_counts: Dict[str, int] = {}
+
+    @property
+    def level_name(self) -> str:
+        return SHED_LEVELS[self.level]
+
+    @property
+    def brownout(self) -> bool:
+        return self.level >= 1
+
+    def evaluate(self, queue_age_s: float, ttft_state: str = "ok") -> int:
+        """Advance the ladder from the current signals; returns the new
+        level.  ``ttft_state`` is an SLOMonitor rule state
+        (``ok``/``burn_slow``/``burn_fast``)."""
+        wm = self._watermark_s
+        target = 0
+        if ttft_state == "burn_slow" or (wm > 0.0 and queue_age_s > wm):
+            target = 1
+        if ttft_state == "burn_fast" or (wm > 0.0 and queue_age_s > 2 * wm):
+            target = 2
+        if wm > 0.0 and queue_age_s > 4 * wm:
+            target = 3
+        if target >= self.level:
+            # pressure at (or above) the current rung is not calm — the
+            # de-escalation counter restarts
+            self.level = target
+            self._calm = 0
+        else:
+            self._calm += 1
+            if self._calm >= self._recovery_steps:
+                self.level -= 1
+                self._calm = 0
+        return self.level
+
+    def admit_ok(self, slo: str) -> bool:
+        """Whether a request of ``slo`` passes the current rung.  Level 2
+        sheds ``batch`` (priority 2); level 3 sheds ``standard`` too;
+        ``realtime`` only ever hits the static ``max_queue`` bound."""
+        if self.level < 2:
+            return True
+        prio = SLO_PRIORITY.get(slo, SLO_PRIORITY["standard"])
+        floor = 2 if self.level == 2 else 1
+        if prio >= floor:
+            self.shed_counts[slo] = self.shed_counts.get(slo, 0) + 1
+            return False
+        return True
+
+    def cap_new_tokens(self, max_new_tokens: int) -> int:
+        """Brownout rung: cap the token budget of admitted requests."""
+        cap = self._brownout_cap
+        if self.brownout and cap > 0:
+            return min(max_new_tokens, cap)
+        return max_new_tokens
 
 
 @dataclass
@@ -75,6 +172,7 @@ class Request:
     restages: int = 0
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    deadline_at: Optional[float] = None   # host clock; None = no deadline
 
     @property
     def priority(self) -> int:
@@ -108,6 +206,7 @@ class ServingScheduler:
         self._admit_counter = itertools.count()
         self.preemption_count = 0
         self.finished_count = 0
+        self.expired_count = 0
         self.spill_count = 0
         self.restage_count = 0
         # engine hook: called with the victim after each eviction (telemetry)
@@ -330,6 +429,66 @@ class ServingScheduler:
             # under a later epoch of a reused block id
             self.tiering.discard(req)
 
+    # ---- deadlines -------------------------------------------------------- #
+    def expired(self, now: float) -> List[Request]:
+        """Every request (waiting or active) whose deadline has passed.
+        Pure scan — cancellation is a separate step so the engine can book
+        the wasted work before the state is torn down."""
+        out = [r for r in self.waiting
+               if r.deadline_at is not None and now >= r.deadline_at]
+        out.extend(r for r in self.active.values()
+                   if r.deadline_at is not None and now >= r.deadline_at)
+        return out
+
+    def cancel(self, req: Request) -> None:
+        """Cancel an expired request at the step boundary: free its slot
+        and arena blocks, drop any staged tier copy, mark it EXPIRED.
+        ``free``/``discard`` are idempotent, so a request that never owned
+        blocks (still waiting) cancels cleanly too."""
+        if req.slot >= 0 and self.active.get(req.slot) is req:
+            del self.active[req.slot]
+            self._free_slots.append(req.slot)
+        elif req in self.waiting:
+            self.waiting.remove(req)
+        self.alloc.free(req.rid)
+        if self.tiering is not None:
+            self.tiering.discard(req)
+        req.slot = -1
+        req.spilled = False
+        req.spilled_tokens = 0
+        req.state = EXPIRED
+        self.expired_count += 1
+
+    def oldest_wait_s(self, now: float) -> float:
+        """Age of the oldest waiting request — the queue-age pressure
+        signal for the admission ladder."""
+        if not self.waiting:
+            return 0.0
+        return max(0.0, now - min(r.arrival for r in self.waiting))
+
+    # ---- wedge recovery --------------------------------------------------- #
+    def requeue_for_recovery(self, allocator: PagedKVAllocator
+                             ) -> List[Request]:
+        """Adopt a freshly rebuilt allocator (the arena was reinitialized
+        after a wedged step) and return every in-flight request to the
+        waiting queue with ``prefilled=0`` — the preemption recompute
+        contract, so greedy decoding resumes token-identical.  Spill
+        records of *waiting* requests survive (host/NVMe bytes are
+        untouched by an arena rebuild); active requests were resident-only
+        and simply recompute.  Returns the requeued requests."""
+        self.alloc = allocator
+        requeued = sorted(self.active.values(), key=lambda r: r.submit_seq)
+        self.active.clear()
+        self._free_slots = list(range(self.num_slots - 1, -1, -1))
+        for req in reversed(requeued):
+            req.slot = -1
+            req.prefilled = 0
+            req.spilled = False
+            req.spilled_tokens = 0
+            req.state = WAITING
+            self.waiting.appendleft(req)   # submit_seq keeps its FIFO place
+        return requeued
+
     # ---- introspection ---------------------------------------------------- #
     @property
     def has_work(self) -> bool:
@@ -344,6 +503,7 @@ class ServingScheduler:
             "blocks_free": self.alloc.free_blocks,
             "preemptions": self.preemption_count,
             "finished": self.finished_count,
+            "expired": self.expired_count,
             "spills": self.spill_count,
             "restages": self.restage_count,
         }

@@ -38,6 +38,9 @@ EF_RESET = "ef_reset"              # compression error-feedback zeroed at load
 SERVE_REQUEST = "serve_request"    # one completed ServingEngine request (TTFT)
 SERVE_STEP = "serve_step"          # serving-loop gauges (queue depth, blocks)
 SERVE_PREEMPT = "serve_preempt"    # SLO/arena preemption (blocks evicted)
+SERVE_SHED = "serve_shed"          # admission-ladder rejection / rung change
+SERVE_EXPIRED = "serve_expired"    # request deadline passed; cancelled
+SERVE_INCIDENT = "serve_incident"  # wedged serve step -> in-process recovery
 KV_SPILL = "kv_spill"              # preempted KV captured to host/NVMe tier
 KV_RESTAGE = "kv_restage"          # spilled KV restored on re-admission
 PREFIX_HIT = "prefix_hit"          # cached prompt blocks attached copy-free
@@ -54,7 +57,8 @@ SCHEMA = "schema"                  # JSONL header record (written by the sink)
 KINDS = (STEP, PIPE, INFERENCE, MOE, COMM_SUMMARY, FLOPS_BREAKDOWN,
          WORKER_EXIT, CKPT_SAVED, CKPT_RETRY, CKPT_ROLLBACK, PREEMPTION,
          ANOMALY, LR_BACKOFF, AUTO_ROLLBACK, BATCH_QUARANTINED, EF_RESET,
-         SERVE_REQUEST, SERVE_STEP, SERVE_PREEMPT, KV_SPILL, KV_RESTAGE,
+         SERVE_REQUEST, SERVE_STEP, SERVE_PREEMPT, SERVE_SHED, SERVE_EXPIRED,
+         SERVE_INCIDENT, KV_SPILL, KV_RESTAGE,
          PREFIX_HIT, PROGRAM_CACHE, OFFLOAD_STAGED, OFFLOAD_WAIT, DOWNTIME,
          GOODPUT, COLLECTIVE_WINDOW, COLLECTIVE_HEALTH, COLLECTIVE_DESYNC,
          SCHEMA)

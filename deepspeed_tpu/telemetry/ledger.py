@@ -304,6 +304,17 @@ class GoodputLedger:
             "wasted_prefill_tokens": 0})
         s["wasted_prefill_tokens"] += int(tokens)
 
+    def note_serve_expired(self, slo, tokens_wasted=0):
+        """A request's deadline passed before it finished: count the
+        cancellation and book whatever prefill it had accumulated as
+        wasted compute."""
+        s = self._serve.setdefault(str(slo), {
+            "finished": 0, "tokens_in_bound": 0, "tokens_late": 0,
+            "wasted_prefill_tokens": 0})
+        s["expired"] = s.get("expired", 0) + 1
+        if tokens_wasted > 0:
+            s["wasted_prefill_tokens"] += int(tokens_wasted)
+
     # ---- derived views -------------------------------------------------- #
 
     def _wall(self, now=None):
@@ -400,12 +411,14 @@ def serve_summary(by_slo):
     total_in = sum(s["tokens_in_bound"] for s in by_slo.values())
     total_late = sum(s["tokens_late"] for s in by_slo.values())
     total_waste = sum(s["wasted_prefill_tokens"] for s in by_slo.values())
+    total_expired = sum(s.get("expired", 0) for s in by_slo.values())
     denom = total_in + total_late + total_waste
     out = {
         "by_slo": {k: dict(v) for k, v in sorted(by_slo.items())},
         "tokens_in_bound": total_in,
         "tokens_late": total_late,
         "wasted_prefill_tokens": total_waste,
+        "expired": total_expired,
         "goodput_tokens_frac": (total_in / denom) if denom else None,
     }
     return out
@@ -432,7 +445,7 @@ def _merge_serve(folded, serve):
     for slo, s in serve.get("by_slo", {}).items():
         dst = folded.setdefault(slo, {
             "finished": 0, "tokens_in_bound": 0, "tokens_late": 0,
-            "wasted_prefill_tokens": 0})
+            "wasted_prefill_tokens": 0, "expired": 0})
         for key in dst:
             dst[key] += int(s.get(key, 0))
 

@@ -649,6 +649,28 @@ class MetricsSink:
     def _on_serve_preempt(self, rec):
         self._preempts.inc()
 
+    def _on_serve_shed(self, rec):
+        if rec.get("event") == "level":
+            self.registry.gauge("serve_shed_level").set(
+                int(rec.get("level", 0)))
+        else:
+            self.registry.counter(
+                "serve_shed_total",
+                {"slo": str(rec.get("slo", "unknown"))}).inc()
+
+    def _on_serve_expired(self, rec):
+        self.registry.counter(
+            "serve_expired_total",
+            {"slo": str(rec.get("slo", "unknown"))}).inc()
+
+    def _on_serve_incident(self, rec):
+        if rec.get("event") != "recovered":
+            return
+        self.registry.counter("serve_incidents_total").inc()
+        if isinstance(rec.get("recovery_s"), (int, float)):
+            self.registry.histogram("serve_incident_recovery_s").observe(
+                rec["recovery_s"])
+
     def _on_kv_spill(self, rec):
         self._spills.inc()
         tier = str(rec.get("tier", "unknown"))
@@ -773,6 +795,9 @@ _SINK_HANDLERS = {
     "serve_request": MetricsSink._on_serve_request,
     "serve_step": MetricsSink._on_serve_step,
     "serve_preempt": MetricsSink._on_serve_preempt,
+    "serve_shed": MetricsSink._on_serve_shed,
+    "serve_expired": MetricsSink._on_serve_expired,
+    "serve_incident": MetricsSink._on_serve_incident,
     "kv_spill": MetricsSink._on_kv_spill,
     "kv_restage": MetricsSink._on_kv_restage,
     "prefix_hit": MetricsSink._on_prefix_hit,

@@ -303,6 +303,20 @@ class SLOMonitor:
         return {"ok": ok, "burning": burning,
                 "burn_events": self.burn_events, "rules": rules}
 
+    def state_for_metric(self, metric: str) -> str:
+        """Worst current burn state among rules sampling ``metric``
+        (``ok`` < ``burn_slow`` < ``burn_fast``) — the serving admission
+        ladder reads the TTFT rules this way without re-evaluating."""
+        rank = {"ok": 0, "burn_slow": 1, "burn_fast": 2}
+        worst = "ok"
+        for rule in self.rules:
+            if rule.metric.split(":", 1)[-1] != metric:
+                continue
+            state = self._state.get(rule.name, "ok")
+            if rank.get(state, 0) > rank[worst]:
+                worst = state
+        return worst
+
 
 def rules_from_config(specs, defaults: bool = True) -> List[SLORule]:
     """Build the rule list from ``telemetry.slo_rules`` config entries —

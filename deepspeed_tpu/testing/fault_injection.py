@@ -15,6 +15,10 @@ site                  fires
 ``ckpt.post_commit``  after commit + atomic rename + ``latest`` move
 ``train.step``        once per optimizer step (ctx: ``step``)
 ``comm.collective``   per staged collective (ctx: ``op``)
+``serve.step``        inside the bounded serve-step dispatch (ctx: ``step``,
+                      ``phase``) — wedge/delay/raise drive serving incidents
+``serve.restage``     before a tiered KV restage (ctx: ``rid``) — raise
+                      forces the recompute fallback
 ``engine.*``          :class:`FaultyCheckpointEngine` wrapper sites
 ``train.loss``        *value site* — the cached loss at the step boundary
 ``train.grads``       *value site* — accumulated grads at the step boundary
@@ -77,6 +81,11 @@ SITES = (
     "comm.collective",
     "engine.create", "engine.save", "engine.post_save", "engine.commit",
     "engine.load",
+    # serving resilience plane: `serve.step` fires inside the bounded
+    # compiled-step dispatch (ctx: step, phase=prefill|decode) — wedge it
+    # to drive a ServeStepTimeout incident; `serve.restage` fires before a
+    # tiered KV restore (ctx: rid) — raise to force the recompute fallback
+    "serve.step", "serve.restage",
 )
 
 # `wedge` parks the firing thread until released — the infinite-delay

@@ -57,8 +57,8 @@ def test_report_folds_and_passes_gates(tool, tmp_path, capsys):
     assert report["preemptions"] == 1 and report["preemption_rate"] == 0.25
     assert report["p99_ttft_ms"] == 30.0
     assert report["peaks"] == {"queue_depth": 3, "active": 4,
-                               "blocks_in_use": 17,
-                               "kv_host_bytes": 0, "kv_nvme_bytes": 0}
+                               "blocks_in_use": 17, "kv_host_bytes": 0,
+                               "kv_nvme_bytes": 0, "shed_level": 0}
     assert set(report["by_slo"]) == {"standard", "realtime", "batch"}
     assert report["by_slo"]["standard"]["finished"] == 2
     # no tiering records: zero-valued columns, stall frac 0 by definition
@@ -139,6 +139,86 @@ def test_tiering_gate_failures(tool, tmp_path, capsys):
     # no prefix lookups at all: hit-rate gate fails rather than passes
     path3 = write_jsonl(tmp_path / "t3.jsonl", sample_records())
     assert tool.main([path3, "--min-prefix-hit-rate", "0.1"]) == 1
+
+
+def resilience_records():
+    """sample_records() plus a shed/expired/incident story: one batch
+    rejection, one expired standard request, one recovered wedge."""
+    recs = sample_records()
+    recs.append({"kind": "serve_shed", "event": "level", "level": 2,
+                 "from": "ok", "to": "shed_batch", "queue_age_ms": 900.0})
+    recs.append({"kind": "serve_shed", "event": "rejected", "slo": "batch",
+                 "level": 2, "level_name": "shed_batch", "queue_depth": 7})
+    recs.append({"kind": "serve_expired", "rid": 9, "slo": "standard",
+                 "age_ms": 2100.0, "deadline_ms": 2000.0, "generated": 1,
+                 "wasted_prefill_tokens": 24})
+    recs.append({"kind": "serve_incident", "event": "begin",
+                 "phase": "decode", "step": 40, "deadline_s": 0.5,
+                 "incident": 1, "in_flight": 3})
+    recs.append({"kind": "serve_incident", "event": "recovered",
+                 "phase": "decode", "step": 40, "requeued": 3, "lost": 0,
+                 "recovery_s": 0.12, "deadline_s": 0.5, "incident": 1})
+    recs.append({"kind": "serve_incident", "event": "cleared",
+                 "phase": "decode", "incident_step": 40})
+    recs.append({"kind": "serve_step", "queue_depth": 5, "active": 2,
+                 "blocks_in_use": 11, "shed_level": 2})
+    return recs
+
+
+def test_resilience_columns_and_gates_pass(tool, tmp_path, capsys):
+    path = write_jsonl(tmp_path / "t.jsonl", resilience_records())
+    rc = tool.main([path, "--max-shed-frac", "0.25",
+                    "--max-deadline-miss-frac", "0.25",
+                    "--forbid-incident-loss"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["shed"] == 1 and report["shed_level_transitions"] == 1
+    assert report["shed_frac"] == 0.2            # 1 / (4 submitted + 1 shed)
+    assert report["expired"] == 1
+    assert report["deadline_miss_frac"] == 0.2   # 1 / (4 finished + 1)
+    assert report["expired_wasted_prefill_tokens"] == 24
+    assert report["by_slo"]["batch"]["shed"] == 1
+    assert report["by_slo"]["standard"]["expired"] == 1
+    assert report["by_slo"]["realtime"] == {
+        "finished": 1, "shed": 0, "expired": 0,
+        "p50_ttft_ms": 8.0, "p99_ttft_ms": 8.0}
+    inc = report["incidents"]
+    assert inc["count"] == 1 and inc["recovered"] == 1 and inc["lost"] == 0
+    assert inc["unrecovered"] == 0 and inc["requeued"] == 3
+    assert inc["p50_recovery_s"] == 0.12 and inc["max_recovery_s"] == 0.12
+    assert report["peaks"]["shed_level"] == 2
+
+
+def test_resilience_gate_failures(tool, tmp_path, capsys):
+    path = write_jsonl(tmp_path / "t.jsonl", resilience_records())
+    assert tool.main([path, "--max-shed-frac", "0.1"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["gates"]["max_shed_frac"]["ok"]
+    assert tool.main([path, "--max-deadline-miss-frac", "0.1"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["gates"]["max_deadline_miss_frac"]["ok"]
+    # an incident that reported lost requests trips the loss gate
+    recs = resilience_records()
+    for r in recs:
+        if r.get("kind") == "serve_incident" and r.get("event") == "recovered":
+            r["lost"] = 2
+    path2 = write_jsonl(tmp_path / "t2.jsonl", recs)
+    assert tool.main([path2, "--forbid-incident-loss"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["gates"]["forbid_incident_loss"]["value"] == 2
+    # ... and so does a begin with no matching recovery (cut artifact)
+    recs2 = [r for r in resilience_records()
+             if not (r.get("kind") == "serve_incident"
+                     and r.get("event") in ("recovered", "cleared"))]
+    path3 = write_jsonl(tmp_path / "t3.jsonl", recs2)
+    assert tool.main([path3, "--forbid-incident-loss"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["incidents"]["unrecovered"] == 1
+    # a run with no shed/expired/incident records passes all three gates
+    clean = write_jsonl(tmp_path / "t4.jsonl", sample_records())
+    assert tool.main([clean, "--max-shed-frac", "0.0",
+                      "--max-deadline-miss-frac", "0.0",
+                      "--forbid-incident-loss"]) == 0
 
 
 def test_usage_errors_exit_2(tool, tmp_path):

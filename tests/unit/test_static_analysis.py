@@ -208,6 +208,45 @@ class TestZeroSyncPass:
         for scope in ("begin", "end", "fingerprint_of"):
             assert list(zero_sync.scope_violations(sf, scope)) == []
 
+    def test_serving_resilience_hot_path_scopes_are_guarded(self):
+        """The admission ladder, deadline scan and queue-age probe run at
+        every serving step boundary — all in the checked-scope roster."""
+        scopes = set(zero_sync.CHECKED_SCOPES)
+        for scope in ("evaluate", "admit_ok", "cap_new_tokens", "expired",
+                      "oldest_wait_s"):
+            assert ("deepspeed_tpu/serving/scheduler.py", scope) in scopes
+        for scope in ("_expire_deadlines", "_update_admission"):
+            assert ("deepspeed_tpu/serving/engine.py", scope) in scopes
+
+    def test_seeded_sync_in_admission_hot_path_is_flagged(self, tmp_path):
+        """A seeded violation in an evaluate()-style ladder step —
+        coercing a device-resident queue gauge into the age signal — is
+        caught."""
+        sf, _ = _scan(tmp_path, (
+            "class Admission:\n"
+            "    def evaluate(self, queue_age_gauge, state):\n"
+            "        age = float(queue_age_gauge)\n"
+            "        depth = queue_age_gauge.item()\n"
+            "        return age + depth\n"))
+        msgs = [m for _, m in zero_sync.scope_violations(sf, "evaluate")]
+        assert len(msgs) == 2
+        assert any("float()" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+
+    def test_live_serving_resilience_hot_path_is_clean(self):
+        """The real scheduler/engine resilience scopes pass with no
+        pragmas — config coercions were hoisted to construction time."""
+        ctx = core.Context()
+        sf = ctx.scan("deepspeed_tpu/serving/scheduler.py",
+                      for_pass="zero-sync")
+        for scope in ("evaluate", "admit_ok", "cap_new_tokens", "expired",
+                      "oldest_wait_s"):
+            assert list(zero_sync.scope_violations(sf, scope)) == []
+        sf = ctx.scan("deepspeed_tpu/serving/engine.py",
+                      for_pass="zero-sync")
+        for scope in ("_expire_deadlines", "_update_admission"):
+            assert list(zero_sync.scope_violations(sf, scope)) == []
+
 
 class TestLockDisciplinePass:
     FIXTURE = (
@@ -324,6 +363,39 @@ class TestLockDisciplinePass:
         assert len(finds) == 2, msgs
         assert any("blocking call" in m and "bad_restage" in m for m in msgs)
         assert any("_seqs" in m and "bad_discard" in m for m in msgs)
+
+    def test_serving_engine_is_in_scope(self):
+        """PR 20's bounded-dispatch + incident recovery made engine.py and
+        scheduler.py lock-adjacent host code (the BoundedCollective worker
+        hand-off) — both must be under the lock-discipline sweep."""
+        files = lock_discipline.checked_files(REPO_ROOT)
+        rel = {os.path.relpath(f, REPO_ROOT).replace(os.sep, "/")
+               for f in files}
+        assert "deepspeed_tpu/serving/engine.py" in rel
+        assert "deepspeed_tpu/serving/scheduler.py" in rel
+
+    def test_seeded_incident_recovery_shape_violations(self, tmp_path):
+        """A miniature of the serve-incident recovery protocol with the
+        two bugs the pass exists to catch: waiting on the abandoned
+        dispatch worker's future while holding the incident lock, and
+        flipping the /healthz latch outside it."""
+        sf, ctx = _scan(tmp_path, (
+            "import threading\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._incident = None  # guarded-by: _lock\n"
+            "    def bad_recover(self, worker_fut):\n"
+            "        with self._lock:\n"
+            "            self._incident = {'phase': 'decode'}\n"
+            "            worker_fut.result()\n"       # wedged-worker wait
+            "    def bad_clear(self):\n"
+            "        self._incident = None\n"))
+        finds = lock_discipline.check_scanned_file(sf, ctx, set())
+        msgs = [f.message for f in finds]
+        assert len(finds) == 2, msgs
+        assert any("blocking call" in m and "bad_recover" in m for m in msgs)
+        assert any("_incident" in m and "bad_clear" in m for m in msgs)
 
     def test_guard_naming_a_nonlock_is_flagged(self, tmp_path):
         sf, ctx = _scan(tmp_path, (

@@ -81,6 +81,17 @@ CHECKED_SCOPES: Sequence[Tuple[str, Optional[str]]] = (
     # no-jax report CLI, which an accidental jax dependency would break).
     ("deepspeed_tpu/autotuning/scoring.py", None),
     ("deepspeed_tpu/autotuning/loop.py", "tune"),
+    # serving resilience hot path: the shed ladder, deadline scan and
+    # queue-age probe run at EVERY engine step boundary between compiled
+    # dispatches — a host sync here stalls the decode pipeline for all
+    # slots.  All signals are host clocks and host counters by contract.
+    ("deepspeed_tpu/serving/scheduler.py", "evaluate"),
+    ("deepspeed_tpu/serving/scheduler.py", "admit_ok"),
+    ("deepspeed_tpu/serving/scheduler.py", "cap_new_tokens"),
+    ("deepspeed_tpu/serving/scheduler.py", "expired"),
+    ("deepspeed_tpu/serving/scheduler.py", "oldest_wait_s"),
+    ("deepspeed_tpu/serving/engine.py", "_expire_deadlines"),
+    ("deepspeed_tpu/serving/engine.py", "_update_admission"),
 )
 
 _NUMPY_MODULES = ("np", "numpy")

@@ -17,7 +17,9 @@ Usage::
 
     python tools/serve_report.py TELEMETRY_JSONL
         [--p99-ttft-ms X] [--max-preemption-rate X]
-        [--max-restage-stall-frac X] [--min-prefix-hit-rate X] [--json OUT]
+        [--max-restage-stall-frac X] [--min-prefix-hit-rate X]
+        [--max-shed-frac X] [--max-deadline-miss-frac X]
+        [--forbid-incident-loss] [--json OUT]
 
 Gates (optional, same contract as ``offload_audit.py``): ``--p99-ttft-ms``
 fails (exit 1) when the p99 time-to-first-token exceeds the bound;
@@ -26,7 +28,14 @@ exceed the bound; ``--max-restage-stall-frac`` fails when blocking
 restage time exceeds that fraction of the run (or when waits exist but
 the run emitted no wall-clock gauge to normalize by);
 ``--min-prefix-hit-rate`` fails when prefix hits / lookups falls below
-the bound (or when no lookups were recorded at all).  Exit 2 on usage
+the bound (or when no lookups were recorded at all).
+
+Resilience columns (``serve_shed``, ``serve_expired``, ``serve_incident``
+records) get their own gates: ``--max-shed-frac`` bounds shed admissions
+over the offered load (submitted + shed), ``--max-deadline-miss-frac``
+bounds expired requests over completions (finished + expired), and
+``--forbid-incident-loss`` fails when any wedge incident reported lost
+requests or began without a matching recovery record.  Exit 2 on usage
 errors (unreadable file / not a telemetry JSONL / no serving records).
 
 Standard library only.
@@ -74,7 +83,7 @@ def fold(records):
     new_tokens = 0
     by_slo = {}
     peak = {"queue_depth": 0, "active": 0, "blocks_in_use": 0,
-            "kv_host_bytes": 0, "kv_nvme_bytes": 0}
+            "kv_host_bytes": 0, "kv_nvme_bytes": 0, "shed_level": 0}
     steps = 0
     spills = restages = restage_failures = prefix_hits = 0
     spill_bytes_by_tier = {}
@@ -83,6 +92,16 @@ def fold(records):
     restage_sources = {}
     elapsed_ms = None          # last serve_step gauge wins (monotonic)
     prefix_lookups = prefix_hits_gauge = None
+    shed = expired = 0
+    shed_transitions = 0
+    expired_wasted_tokens = 0
+    incidents = {"count": 0, "recovered": 0, "cleared": 0, "lost": 0,
+                 "requeued": 0, "recovery_s": []}
+
+    def _slo_row(slo):
+        return by_slo.setdefault(slo, {"finished": 0, "shed": 0,
+                                       "expired": 0, "ttft_ms": []})
+
     for rec in records:
         kind = rec.get("kind")
         if kind == "serve_request":
@@ -91,8 +110,7 @@ def fold(records):
             elif rec.get("event") == "finished":
                 finished += 1
                 new_tokens += int(rec.get("new_tokens", 0))
-                slo = str(rec.get("slo", "standard"))
-                s = by_slo.setdefault(slo, {"finished": 0, "ttft_ms": []})
+                s = _slo_row(str(rec.get("slo", "standard")))
                 s["finished"] += 1
                 if "ttft_ms" in rec:
                     ttfts.append(float(rec["ttft_ms"]))
@@ -101,6 +119,28 @@ def fold(records):
                     latencies.append(float(rec["latency_ms"]))
                 if "tokens_per_sec" in rec:
                     tps.append(float(rec["tokens_per_sec"]))
+        elif kind == "serve_shed":
+            if rec.get("event") == "level":
+                shed_transitions += 1
+            else:
+                shed += 1
+                _slo_row(str(rec.get("slo", "standard")))["shed"] += 1
+        elif kind == "serve_expired":
+            expired += 1
+            _slo_row(str(rec.get("slo", "standard")))["expired"] += 1
+            expired_wasted_tokens += int(rec.get("wasted_prefill_tokens", 0))
+        elif kind == "serve_incident":
+            ev = rec.get("event")
+            if ev == "begin":
+                incidents["count"] += 1
+            elif ev == "recovered":
+                incidents["recovered"] += 1
+                incidents["lost"] += int(rec.get("lost", 0))
+                incidents["requeued"] += int(rec.get("requeued", 0))
+                if "recovery_s" in rec:
+                    incidents["recovery_s"].append(float(rec["recovery_s"]))
+            elif ev == "cleared":
+                incidents["cleared"] += 1
         elif kind == "serve_preempt":
             preempts += 1
         elif kind == "kv_spill":
@@ -151,6 +191,16 @@ def fold(records):
         prefix_hit_rate = round(prefix_hits_gauge / prefix_lookups, 4)
     else:
         prefix_hit_rate = None
+    recovery_s = sorted(incidents.pop("recovery_s"))
+    incidents["p50_recovery_s"] = _pct(recovery_s, 0.50)
+    incidents["max_recovery_s"] = recovery_s[-1] if recovery_s else None
+    # An incident that began but never recovered is in-flight loss: the
+    # engine died (or the artifact was cut) mid-rebuild, so its requeued
+    # requests cannot be accounted for.  --forbid-incident-loss treats it
+    # the same as an explicit lost>0 on a recovered record.
+    incidents["unrecovered"] = max(0, incidents["count"]
+                                   - incidents["recovered"])
+    offered = submitted + shed
     return {
         "submitted": submitted,
         "finished": finished,
@@ -177,6 +227,14 @@ def fold(records):
         "restage_stall_frac": stall_frac,
         "prefix_hits": prefix_hits,
         "prefix_hit_rate": prefix_hit_rate,
+        "shed": shed,
+        "shed_frac": round(shed / offered, 4) if offered else 0.0,
+        "shed_level_transitions": shed_transitions,
+        "expired": expired,
+        "deadline_miss_frac": (round(expired / (finished + expired), 4)
+                               if (finished + expired) else 0.0),
+        "expired_wasted_prefill_tokens": expired_wasted_tokens,
+        "incidents": incidents,
         "elapsed_ms": elapsed_ms,
     }
 
@@ -195,6 +253,15 @@ def main(argv=None) -> int:
     ap.add_argument("--min-prefix-hit-rate", type=float, default=None,
                     help="fail (exit 1) if prefix hits/lookups falls below "
                          "this (or no lookups were recorded)")
+    ap.add_argument("--max-shed-frac", type=float, default=None,
+                    help="fail (exit 1) if shed/(submitted+shed) exceeds "
+                         "this fraction")
+    ap.add_argument("--max-deadline-miss-frac", type=float, default=None,
+                    help="fail (exit 1) if expired/(finished+expired) "
+                         "exceeds this fraction")
+    ap.add_argument("--forbid-incident-loss", action="store_true",
+                    help="fail (exit 1) if any serve incident lost requests "
+                         "or never recovered")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="also write the report to this file")
     args = ap.parse_args(argv)
@@ -237,6 +304,26 @@ def main(argv=None) -> int:
             "limit": args.min_prefix_hit_rate,
             "value": val,
             "ok": val is not None and val >= args.min_prefix_hit_rate,
+        }
+    if args.max_shed_frac is not None:
+        gates["max_shed_frac"] = {
+            "limit": args.max_shed_frac,
+            "value": report["shed_frac"],
+            "ok": report["shed_frac"] <= args.max_shed_frac,
+        }
+    if args.max_deadline_miss_frac is not None:
+        gates["max_deadline_miss_frac"] = {
+            "limit": args.max_deadline_miss_frac,
+            "value": report["deadline_miss_frac"],
+            "ok": report["deadline_miss_frac"] <= args.max_deadline_miss_frac,
+        }
+    if args.forbid_incident_loss:
+        inc = report["incidents"]
+        loss = inc["lost"] + inc["unrecovered"]
+        gates["forbid_incident_loss"] = {
+            "limit": 0,
+            "value": loss,
+            "ok": loss == 0,
         }
     report["ok"] = all(g["ok"] for g in gates.values())
     return _stats.finalize_report("serve_report", report, gates=gates,
